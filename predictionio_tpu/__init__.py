@@ -1,0 +1,30 @@
+"""predictionio_tpu — a TPU-native machine-learning server.
+
+A ground-up rebuild of the capabilities of Apache PredictionIO
+(reference fork: Algorithmicinsights/predictionio) with the Spark/MLlib
+compute substrate replaced by JAX/XLA/Pallas on a TPU ICI mesh:
+
+- **Event Server** — HTTP ingestion of behavioral JSON events into an
+  append-only, channel-partitioned event store
+  (reference: data/src/main/scala/org/apache/predictionio/data/api/).
+- **DASE controller API** — DataSource / Preparator / Algorithm / Serving /
+  Evaluator engine contract
+  (reference: core/src/main/scala/org/apache/predictionio/controller/).
+- **Workflow** — train / eval orchestration with engine-instance lifecycle
+  (reference: core/src/main/scala/org/apache/predictionio/workflow/).
+- **Serving** — low-latency REST `/queries.json` with continuous batching on
+  compiled XLA executables
+  (reference: core/.../workflow/CreateServer.scala).
+- **CLI** — `pio`-style verbs (app / accesskey / train / deploy / eval /
+  eventserver / import / export / status)
+  (reference: tools/src/main/scala/org/apache/predictionio/tools/).
+
+The compute path is idiomatic JAX: engines' train/predict compile with
+`jax.jit` over a `jax.sharding.Mesh` (data / model / sequence / expert axes),
+inter-chip traffic is XLA collectives over ICI, and hot ops get Pallas
+kernels where XLA's defaults underperform.
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

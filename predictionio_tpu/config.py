@@ -1,0 +1,180 @@
+"""Layered infrastructure configuration.
+
+Reference: conf/pio-env.sh.template + data/.../data/storage/Storage.scala's
+``StorageClientConfig`` env parsing.  The reference reads::
+
+    PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}
+    PIO_STORAGE_SOURCES_<SOURCE>_{TYPE,HOSTS,PORTS,PATH,...}
+
+We keep exactly that env contract (layer (a) of the reference's config system,
+SURVEY.md §5.6), add an optional TOML file (``$PIO_HOME/pio-env.toml`` or
+``$PIO_CONFIG_FILE``) as the shell-template analogue, and default to fully
+local backends so a fresh checkout works with zero configuration:
+
+- METADATA  → sqlite   at ``$PIO_HOME/storage/pio.db``
+- EVENTDATA → sqlite   at ``$PIO_HOME/storage/pio.db``  (events + metadata can
+  share a db file; the parquet event-log source is available for batch-heavy
+  apps via ``PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=PARQUET``)
+- MODELDATA → localfs  at ``$PIO_HOME/storage/models``
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional
+
+__all__ = ["StorageSourceConfig", "RepositoryConfig", "PioConfig", "load_config", "pio_home"]
+
+_REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+
+def pio_home(env: Optional[Mapping[str, str]] = None) -> Path:
+    env = env if env is not None else os.environ
+    home = env.get("PIO_HOME")
+    if home:
+        return Path(home)
+    return Path(env.get("HOME", "/tmp")) / ".predictionio_tpu"
+
+
+@dataclass(frozen=True)
+class StorageSourceConfig:
+    """One named storage source (reference: StorageClientConfig)."""
+
+    name: str
+    type: str                      # sqlite | parquetlog | localfs | memory
+    properties: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def path(self) -> Optional[str]:
+        return self.properties.get("PATH")
+
+
+@dataclass(frozen=True)
+class RepositoryConfig:
+    """Binding of a logical repository to a source (reference: repositories map)."""
+
+    repo: str                      # METADATA | EVENTDATA | MODELDATA
+    namespace: str                 # table/keyspace prefix (reference: _NAME)
+    source: str                    # source name (reference: _SOURCE)
+
+
+@dataclass(frozen=True)
+class PioConfig:
+    home: Path
+    sources: Dict[str, StorageSourceConfig]
+    repositories: Dict[str, RepositoryConfig]
+    extra: Dict[str, str] = field(default_factory=dict)
+
+    def source_for(self, repo: str) -> StorageSourceConfig:
+        rc = self.repositories[repo.upper()]
+        try:
+            return self.sources[rc.source]
+        except KeyError:
+            raise KeyError(
+                f"Repository {repo} points at undefined storage source "
+                f"{rc.source!r}; defined sources: {sorted(self.sources)}"
+            ) from None
+
+
+def _defaults(home: Path) -> Dict[str, str]:
+    storage = home / "storage"
+    return {
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "pio_model",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(storage / "pio.db"),
+        "PIO_STORAGE_SOURCES_PARQUET_TYPE": "parquetlog",
+        "PIO_STORAGE_SOURCES_PARQUET_PATH": str(storage / "events"),
+        "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_LOCALFS_PATH": str(storage / "models"),
+        "PIO_STORAGE_SOURCES_MEMORY_TYPE": "memory",
+    }
+
+
+def _load_toml(path: Path) -> Dict[str, str]:
+    """Flatten a TOML file into PIO_* env-style keys.
+
+    Either literal env keys under ``[env]`` or structured::
+
+        [storage.repositories.eventdata]
+        name = "pio_event"
+        source = "PARQUET"
+        [storage.sources.PARQUET]
+        type = "parquetlog"
+        path = "/data/events"
+    """
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    flat: Dict[str, str] = {}
+    for k, v in (doc.get("env") or {}).items():
+        flat[str(k)] = str(v)
+    storage = doc.get("storage") or {}
+    for repo, spec in (storage.get("repositories") or {}).items():
+        up = repo.upper()
+        if "name" in spec:
+            flat[f"PIO_STORAGE_REPOSITORIES_{up}_NAME"] = str(spec["name"])
+        if "source" in spec:
+            flat[f"PIO_STORAGE_REPOSITORIES_{up}_SOURCE"] = str(spec["source"]).upper()
+    for src, spec in (storage.get("sources") or {}).items():
+        up = src.upper()
+        for pk, pv in spec.items():
+            flat[f"PIO_STORAGE_SOURCES_{up}_{pk.upper()}"] = str(pv)
+    return flat
+
+
+def load_config(
+    env: Optional[Mapping[str, str]] = None,
+    config_file: Optional[os.PathLike] = None,
+) -> PioConfig:
+    """Resolve config with precedence env > TOML file > defaults."""
+    env = dict(env if env is not None else os.environ)
+    home = pio_home(env)
+    merged = _defaults(home)
+    toml_path = Path(config_file) if config_file else None
+    if toml_path is None:
+        cand = env.get("PIO_CONFIG_FILE")
+        if cand:
+            toml_path = Path(cand)
+        elif (home / "pio-env.toml").exists():
+            toml_path = home / "pio-env.toml"
+    if toml_path is not None and toml_path.exists():
+        merged.update(_load_toml(toml_path))
+    merged.update({k: v for k, v in env.items() if k.startswith("PIO_")})
+
+    sources: Dict[str, StorageSourceConfig] = {}
+    prefix = "PIO_STORAGE_SOURCES_"
+    names = set()
+    for key in merged:
+        if key.startswith(prefix) and key.endswith("_TYPE"):
+            names.add(key[len(prefix):-len("_TYPE")])
+    for name in names:
+        props = {}
+        p = f"{prefix}{name}_"
+        for key, val in merged.items():
+            if key.startswith(p) and key != f"{p}TYPE":
+                props[key[len(p):]] = val
+        sources[name] = StorageSourceConfig(
+            name=name, type=merged[f"{p}TYPE"], properties=props
+        )
+
+    repositories: Dict[str, RepositoryConfig] = {}
+    for repo in _REPOSITORIES:
+        nk = f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"
+        sk = f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"
+        repositories[repo] = RepositoryConfig(
+            repo=repo, namespace=merged[nk], source=merged[sk].upper()
+        )
+
+    extra = {
+        k: v
+        for k, v in merged.items()
+        if k.startswith("PIO_") and not k.startswith(("PIO_STORAGE_REPOSITORIES_", "PIO_STORAGE_SOURCES_"))
+    }
+    return PioConfig(home=home, sources=sources, repositories=repositories, extra=extra)

@@ -1,0 +1,30 @@
+"""Event model, data types, and storage abstraction.
+
+Reference layer: data/src/main/scala/org/apache/predictionio/data/
+(upstream Apache PredictionIO path; the reference mount was empty at survey
+time — see SURVEY.md header).
+"""
+
+from predictionio_tpu.data.event import (
+    BiMap,
+    DataMap,
+    DataMapError,
+    Event,
+    EventValidationError,
+    PropertyMap,
+    aggregate_properties,
+    is_reserved_event,
+    validate_event,
+)
+
+__all__ = [
+    "BiMap",
+    "DataMap",
+    "DataMapError",
+    "Event",
+    "EventValidationError",
+    "PropertyMap",
+    "aggregate_properties",
+    "is_reserved_event",
+    "validate_event",
+]

@@ -1,0 +1,30 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the rebuild's analogue of the reference's Spark ``local[n]`` test
+substrate (SURVEY.md §4): real sharding/collective semantics, one process,
+no accelerator.  Must run before any ``import jax`` resolves a backend.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def pio_home(tmp_path, monkeypatch):
+    """Isolated PIO_HOME per test."""
+    home = tmp_path / "pio_home"
+    home.mkdir()
+    monkeypatch.setenv("PIO_HOME", str(home))
+    for k in list(os.environ):
+        if k.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(k, raising=False)
+    return home

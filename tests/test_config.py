@@ -1,0 +1,52 @@
+"""Config layering tests (reference: Storage env parsing + pio-env template)."""
+
+from pathlib import Path
+
+from predictionio_tpu.config import load_config
+
+
+def test_defaults(pio_home):
+    cfg = load_config()
+    assert cfg.home == pio_home
+    assert cfg.repositories["METADATA"].source == "SQLITE"
+    assert cfg.repositories["EVENTDATA"].source == "SQLITE"
+    assert cfg.repositories["MODELDATA"].source == "LOCALFS"
+    assert cfg.source_for("metadata").type == "sqlite"
+    assert cfg.source_for("MODELDATA").type == "localfs"
+    assert Path(cfg.source_for("METADATA").path).is_relative_to(pio_home)
+
+
+def test_env_overrides(pio_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PARQUET")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_PARQUET_PATH", "/data/ev")
+    cfg = load_config()
+    src = cfg.source_for("EVENTDATA")
+    assert src.type == "parquetlog"
+    assert src.path == "/data/ev"
+
+
+def test_toml_layer(pio_home, monkeypatch):
+    toml = pio_home / "pio-env.toml"
+    toml.write_text(
+        """
+[storage.repositories.eventdata]
+source = "PARQUET"
+[storage.sources.PARQUET]
+type = "parquetlog"
+path = "/toml/events"
+"""
+    )
+    cfg = load_config()
+    assert cfg.source_for("EVENTDATA").path == "/toml/events"
+    # env beats TOML
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_PARQUET_PATH", "/env/wins")
+    cfg2 = load_config()
+    assert cfg2.source_for("EVENTDATA").path == "/env/wins"
+
+
+def test_custom_source_definition(pio_home, monkeypatch):
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MYDB_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MYDB_PATH", "/custom/db.sqlite")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MYDB")
+    cfg = load_config()
+    assert cfg.source_for("METADATA").path == "/custom/db.sqlite"

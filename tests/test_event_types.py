@@ -1,0 +1,225 @@
+"""Unit tests for Event/DataMap/PropertyMap/BiMap.
+
+Modeled on the reference's DataMapSpec / BiMapSpec / EventValidation suites
+(data/src/test/scala — SURVEY.md §4).
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import (
+    BiMap,
+    DataMap,
+    DataMapError,
+    Event,
+    EventValidationError,
+    aggregate_properties,
+    validate_event,
+)
+from predictionio_tpu.data.json_support import (
+    event_from_json,
+    event_to_json,
+    parse_iso8601,
+)
+
+UTC = dt.timezone.utc
+
+
+def ts(s):
+    return dt.datetime.fromisoformat(s).replace(tzinfo=UTC)
+
+
+class TestDataMap:
+    def test_typed_getters(self):
+        dm = DataMap({"a": 1, "b": "x", "c": 2.5, "d": True, "e": ["p", "q"], "f": [1, 2.5]})
+        assert dm.get_int("a") == 1
+        assert dm.get_string("b") == "x"
+        assert dm.get_double("c") == 2.5
+        assert dm.get_double("a") == 1.0
+        assert dm.get_boolean("d") is True
+        assert dm.get_string_list("e") == ["p", "q"]
+        assert dm.get_double_list("f") == [1.0, 2.5]
+
+    def test_missing_and_mistyped(self):
+        dm = DataMap({"a": 1, "n": None})
+        with pytest.raises(DataMapError):
+            dm.get_string("missing")
+        with pytest.raises(DataMapError):
+            dm.get_string("a")
+        with pytest.raises(DataMapError):
+            dm.get_int("n")
+        with pytest.raises(DataMapError):
+            dm.get_int("a2")
+
+    def test_bool_is_not_int(self):
+        dm = DataMap({"d": True})
+        with pytest.raises(DataMapError):
+            dm.get_int("d")
+
+    def test_opt_getters(self):
+        dm = DataMap({"a": 1, "n": None})
+        assert dm.opt_int("a") == 1
+        assert dm.opt_int("n") is None
+        assert dm.opt_int("missing") is None
+        assert dm.opt_string_list("missing") is None
+
+    def test_union_and_subtract(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert a.union(b).to_dict() == {"x": 1, "y": 3, "z": 4}
+        assert a.subtract_keys(["y"]).to_dict() == {"x": 1}
+
+    def test_mapping_protocol(self):
+        dm = DataMap({"x": 1})
+        assert "x" in dm and len(dm) == 1 and list(dm) == ["x"]
+        assert dm == DataMap({"x": 1})
+        assert dm == {"x": 1}
+
+
+class TestBiMap:
+    def test_string_int_contiguous_first_seen(self):
+        bm = BiMap.string_int(["u3", "u1", "u3", "u2", "u1"])
+        assert bm["u3"] == 0 and bm["u1"] == 1 and bm["u2"] == 2
+        assert len(bm) == 3
+
+    def test_inverse(self):
+        bm = BiMap.string_int(["a", "b"])
+        assert bm.inverse[0] == "a" and bm.inverse[1] == "b"
+        assert bm.inverse.inverse["a"] == 0
+
+    def test_unique_values_required(self):
+        with pytest.raises(ValueError):
+            BiMap({"a": 1, "b": 1})
+
+    def test_to_numpy_keys(self):
+        bm = BiMap.string_int(["b", "a", "c"])
+        np.testing.assert_array_equal(bm.to_numpy_keys(), np.array(["b", "a", "c"]))
+
+
+class TestValidation:
+    def _ev(self, **kw):
+        base = dict(event="rate", entity_type="user", entity_id="u1")
+        base.update(kw)
+        return Event(**base)
+
+    def test_valid_plain_event(self):
+        validate_event(self._ev(target_entity_type="item", target_entity_id="i1"))
+
+    def test_empty_fields_rejected(self):
+        for kw in ({"event": ""}, {"entity_type": ""}, {"entity_id": ""}):
+            with pytest.raises(EventValidationError):
+                validate_event(self._ev(**kw))
+
+    def test_unknown_reserved_event_rejected(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self._ev(event="$bogus"))
+
+    def test_set_ok_unset_needs_props(self):
+        validate_event(self._ev(event="$set", properties=DataMap({"a": 1})))
+        with pytest.raises(EventValidationError):
+            validate_event(self._ev(event="$unset"))
+
+    def test_reserved_event_cannot_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(
+                self._ev(event="$set", properties=DataMap({"a": 1}),
+                         target_entity_type="item", target_entity_id="i1")
+            )
+
+    def test_target_fields_come_together(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self._ev(target_entity_type="item"))
+
+    def test_pio_prefix_reserved(self):
+        with pytest.raises(EventValidationError):
+            validate_event(self._ev(properties=DataMap({"pio_score": 1})))
+
+
+class TestAggregateProperties:
+    def _set(self, t, props):
+        return Event(event="$set", entity_type="user", entity_id="u1",
+                     properties=DataMap(props), event_time=ts(t))
+
+    def _unset(self, t, keys):
+        return Event(event="$unset", entity_type="user", entity_id="u1",
+                     properties=DataMap({k: None for k in keys}), event_time=ts(t))
+
+    def _delete(self, t):
+        return Event(event="$delete", entity_type="user", entity_id="u1",
+                     event_time=ts(t))
+
+    def test_last_write_wins_in_event_time_order(self):
+        # Deliberately out of order: fold must sort by event_time.
+        evs = [
+            self._set("2026-01-03T00:00:00", {"a": 3}),
+            self._set("2026-01-01T00:00:00", {"a": 1, "b": "x"}),
+            self._set("2026-01-02T00:00:00", {"a": 2, "c": True}),
+        ]
+        pm = aggregate_properties(evs)
+        assert pm.to_dict() == {"a": 3, "b": "x", "c": True}
+        assert pm.first_updated == ts("2026-01-01T00:00:00")
+        assert pm.last_updated == ts("2026-01-03T00:00:00")
+
+    def test_unset_removes_keys(self):
+        evs = [
+            self._set("2026-01-01T00:00:00", {"a": 1, "b": 2}),
+            self._unset("2026-01-02T00:00:00", ["a"]),
+        ]
+        pm = aggregate_properties(evs)
+        assert pm.to_dict() == {"b": 2}
+        assert pm.last_updated == ts("2026-01-02T00:00:00")
+
+    def test_delete_resets_entity(self):
+        evs = [
+            self._set("2026-01-01T00:00:00", {"a": 1}),
+            self._delete("2026-01-02T00:00:00"),
+        ]
+        assert aggregate_properties(evs) is None
+        evs.append(self._set("2026-01-03T00:00:00", {"z": 9}))
+        pm = aggregate_properties(evs)
+        assert pm.to_dict() == {"z": 9}
+        assert pm.first_updated == ts("2026-01-03T00:00:00")
+
+    def test_never_set_is_none(self):
+        ev = Event(event="view", entity_type="user", entity_id="u1")
+        assert aggregate_properties([ev]) is None
+
+
+class TestJsonCodec:
+    def test_roundtrip(self):
+        src = {
+            "event": "buy",
+            "entityType": "user",
+            "entityId": "u7",
+            "targetEntityType": "item",
+            "targetEntityId": "i3",
+            "properties": {"price": 9.99, "tags": ["a"]},
+            "eventTime": "2026-07-01T12:34:56.789+00:00",
+        }
+        ev = event_from_json(src)
+        assert ev.event_time == ts("2026-07-01T12:34:56.789")
+        out = event_to_json(ev)
+        for k in ("event", "entityType", "entityId", "targetEntityType",
+                  "targetEntityId", "properties"):
+            assert out[k] == src[k]
+        assert out["eventTime"].startswith("2026-07-01T12:34:56.789")
+
+    def test_z_suffix_and_naive_default_utc(self):
+        assert parse_iso8601("2026-01-01T00:00:00Z") == ts("2026-01-01T00:00:00")
+        assert parse_iso8601("2026-01-01T00:00:00") == ts("2026-01-01T00:00:00")
+        offset = parse_iso8601("2026-01-01T02:00:00+02:00")
+        assert offset == ts("2026-01-01T00:00:00")
+
+    def test_missing_required_field(self):
+        with pytest.raises(EventValidationError):
+            event_from_json({"event": "x", "entityType": "user"})
+
+    def test_invalid_reserved_event_via_json(self):
+        with pytest.raises(EventValidationError):
+            event_from_json({"event": "$nope", "entityType": "user", "entityId": "u"})
+
+    def test_defaults_event_time_now(self):
+        ev = event_from_json({"event": "view", "entityType": "u", "entityId": "1"})
+        assert ev.event_time.tzinfo is not None

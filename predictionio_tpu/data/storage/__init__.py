@@ -1,0 +1,250 @@
+"""Storage registry — env-configured backend instantiation.
+
+Reference: data/.../data/storage/Storage.scala — reads ``PIO_STORAGE_*``
+config, reflectively instantiates backend clients, and exposes typed
+repository getters for the three logical stores (METADATA / EVENTDATA /
+MODELDATA).  Here "reflection" is a registry of backend factory functions
+keyed by source ``type``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from predictionio_tpu.config import PioConfig, StorageSourceConfig, load_config
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (  # re-export
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    Events,
+    Model,
+    Models,
+    StorageError,
+)
+
+__all__ = [
+    "Storage",
+    "get_storage",
+    "reset_storage",
+    "register_backend",
+    "App", "Apps", "AccessKey", "AccessKeys", "Channel", "Channels",
+    "EngineInstance", "EngineInstances", "EvaluationInstance",
+    "EvaluationInstances", "Model", "Models", "Events", "StorageError",
+]
+
+
+class _Backend:
+    """A constructed storage client for one source; repo accessors per kind."""
+
+    def __init__(self, source: StorageSourceConfig, namespace: str):
+        self.source = source
+        self.namespace = namespace
+
+    def events(self) -> Events:
+        raise StorageError(f"Source type {self.source.type} has no events support.")
+
+    def apps(self) -> Apps:
+        raise StorageError(f"Source type {self.source.type} has no metadata support.")
+
+    def access_keys(self) -> AccessKeys:
+        raise StorageError(f"Source type {self.source.type} has no metadata support.")
+
+    def channels(self) -> Channels:
+        raise StorageError(f"Source type {self.source.type} has no metadata support.")
+
+    def engine_instances(self) -> EngineInstances:
+        raise StorageError(f"Source type {self.source.type} has no metadata support.")
+
+    def evaluation_instances(self) -> EvaluationInstances:
+        raise StorageError(f"Source type {self.source.type} has no metadata support.")
+
+    def models(self) -> Models:
+        raise StorageError(f"Source type {self.source.type} has no models support.")
+
+    def close(self) -> None:
+        pass
+
+
+class _SQLiteBackend(_Backend):
+    def __init__(self, source, namespace):
+        super().__init__(source, namespace)
+        from predictionio_tpu.data.storage.sqlite import SQLiteClient
+
+        path = source.path
+        if not path:
+            raise StorageError(f"sqlite source {source.name} needs a PATH property.")
+        self._client = SQLiteClient(path, namespace=namespace)
+
+    def events(self): return self._client.events()
+    def apps(self): return self._client.apps()
+    def access_keys(self): return self._client.access_keys()
+    def channels(self): return self._client.channels()
+    def engine_instances(self): return self._client.engine_instances()
+    def evaluation_instances(self): return self._client.evaluation_instances()
+    def models(self): return self._client.models()
+    def close(self): self._client.close()
+
+
+class _ParquetBackend(_Backend):
+    def __init__(self, source, namespace):
+        super().__init__(source, namespace)
+        from predictionio_tpu.data.storage.parquet_events import ParquetEvents
+
+        path = source.path
+        if not path:
+            raise StorageError(f"parquetlog source {source.name} needs a PATH property.")
+        self._events = ParquetEvents(path)
+
+    def events(self): return self._events
+
+
+class _LocalFSBackend(_Backend):
+    def __init__(self, source, namespace):
+        super().__init__(source, namespace)
+        from predictionio_tpu.data.storage.localfs_models import LocalFSModels
+
+        path = source.path
+        if not path:
+            raise StorageError(f"localfs source {source.name} needs a PATH property.")
+        self._models = LocalFSModels(path)
+
+    def models(self): return self._models
+
+
+class _MemoryBackend(_Backend):
+    def __init__(self, source, namespace):
+        super().__init__(source, namespace)
+        from predictionio_tpu.data.storage import memory as m
+
+        self._events = m.MemoryEvents()
+        self._apps = m.MemoryApps()
+        self._keys = m.MemoryAccessKeys()
+        self._channels = m.MemoryChannels()
+        self._engine_instances = m.MemoryEngineInstances()
+        self._evaluation_instances = m.MemoryEvaluationInstances()
+        self._models = m.MemoryModels()
+
+    def events(self): return self._events
+    def apps(self): return self._apps
+    def access_keys(self): return self._keys
+    def channels(self): return self._channels
+    def engine_instances(self): return self._engine_instances
+    def evaluation_instances(self): return self._evaluation_instances
+    def models(self): return self._models
+
+
+_BACKEND_TYPES: Dict[str, Callable[[StorageSourceConfig, str], _Backend]] = {
+    "sqlite": _SQLiteBackend,
+    "parquetlog": _ParquetBackend,
+    "localfs": _LocalFSBackend,
+    "memory": _MemoryBackend,
+}
+
+
+def register_backend(type_name: str, factory: Callable[[StorageSourceConfig, str], _Backend]) -> None:
+    """Plugin point for new storage types (reference: reflective client load)."""
+    _BACKEND_TYPES[type_name] = factory
+
+
+class Storage:
+    """Typed repository getters over configured backends.
+
+    Reference getters: ``Storage.getLEvents`` / ``getPEvents`` /
+    ``getMetaDataApps`` / ``getModelDataModels`` etc.  The L/P split
+    collapses into :meth:`get_events` (see base.Events docstring).
+    """
+
+    def __init__(self, config: Optional[PioConfig] = None):
+        self.config = config or load_config()
+        self._backends: Dict[str, _Backend] = {}
+        self._lock = threading.Lock()
+
+    def _backend_for(self, repo: str) -> _Backend:
+        rc = self.config.repositories[repo.upper()]
+        cache_key = f"{rc.source}:{rc.namespace}"
+        with self._lock:
+            if cache_key not in self._backends:
+                source = self.config.source_for(repo)
+                try:
+                    factory = _BACKEND_TYPES[source.type]
+                except KeyError:
+                    raise StorageError(
+                        f"Unknown storage source type {source.type!r} "
+                        f"(registered: {sorted(_BACKEND_TYPES)})"
+                    ) from None
+                self._backends[cache_key] = factory(source, rc.namespace)
+            return self._backends[cache_key]
+
+    # EVENTDATA
+    def get_events(self) -> Events:
+        return self._backend_for("EVENTDATA").events()
+
+    # METADATA
+    def get_apps(self) -> Apps:
+        return self._backend_for("METADATA").apps()
+
+    def get_access_keys(self) -> AccessKeys:
+        return self._backend_for("METADATA").access_keys()
+
+    def get_channels(self) -> Channels:
+        return self._backend_for("METADATA").channels()
+
+    def get_engine_instances(self) -> EngineInstances:
+        return self._backend_for("METADATA").engine_instances()
+
+    def get_evaluation_instances(self) -> EvaluationInstances:
+        return self._backend_for("METADATA").evaluation_instances()
+
+    # MODELDATA
+    def get_models(self) -> Models:
+        return self._backend_for("MODELDATA").models()
+
+    def close(self) -> None:
+        with self._lock:
+            for b in self._backends.values():
+                b.close()
+            self._backends.clear()
+
+    def verify(self) -> Dict[str, str]:
+        """Touch all three stores; returns repo→source-type map (pio status)."""
+        out = {}
+        for repo, getter in (
+            ("METADATA", self.get_apps),
+            ("EVENTDATA", self.get_events),
+            ("MODELDATA", self.get_models),
+        ):
+            getter()
+            out[repo] = self.config.source_for(repo).type
+        return out
+
+
+_global: Optional[Storage] = None
+_global_lock = threading.Lock()
+
+
+def get_storage(config: Optional[PioConfig] = None) -> Storage:
+    """Process-wide storage singleton (reference: Storage object)."""
+    global _global
+    with _global_lock:
+        if _global is None or config is not None:
+            if _global is not None:
+                _global.close()
+            _global = Storage(config)
+        return _global
+
+
+def reset_storage() -> None:
+    global _global
+    with _global_lock:
+        if _global is not None:
+            _global.close()
+        _global = None

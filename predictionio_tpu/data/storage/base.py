@@ -1,0 +1,459 @@
+"""Storage abstraction: metadata records + repository traits.
+
+Reference: data/src/main/scala/org/apache/predictionio/data/storage/ —
+the ``LEvents`` / ``PEvents`` / ``Models`` / ``Apps`` / ``AccessKeys`` /
+``Channels`` / ``EngineInstances`` / ``EvaluationInstances`` traits that every
+backend plugin implements (SURVEY.md §1 L2).
+
+Design departure from the reference (deliberate, TPU-first): the reference
+splits event reads into ``LEvents`` (iterator, serving path) and ``PEvents``
+(RDD, training path).  Here a single :class:`Events` trait carries both:
+``find`` yields :class:`Event` objects (the L path) and ``find_columnar``
+returns a ``pyarrow.Table`` (the P path) — columnar batches are what feeds
+host-sharded ``jax.Array`` construction, replacing RDD partitions.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from predictionio_tpu.data.event import Event, PropertyMap
+
+__all__ = [
+    "App",
+    "AccessKey",
+    "Channel",
+    "EngineInstance",
+    "EvaluationInstance",
+    "Model",
+    "Apps",
+    "AccessKeys",
+    "Channels",
+    "EngineInstances",
+    "EvaluationInstances",
+    "Models",
+    "Events",
+    "EVENT_ARROW_SCHEMA",
+    "StorageError",
+]
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Metadata records (reference: App.scala, AccessKey.scala, Channel.scala,
+# EngineInstance.scala, EvaluationInstance.scala, Model.scala)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class App:
+    id: Optional[int]
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass
+class AccessKey:
+    key: str
+    app_id: int
+    events: Sequence[str] = ()          # allowlist; empty = all events permitted
+
+    @staticmethod
+    def generate(app_id: int, events: Sequence[str] = ()) -> "AccessKey":
+        return AccessKey(key=secrets.token_urlsafe(48), app_id=app_id, events=tuple(events))
+
+
+@dataclass
+class Channel:
+    id: Optional[int]
+    name: str
+    app_id: int
+
+    NAME_MAX = 16
+
+    @staticmethod
+    def is_valid_name(name: str) -> bool:
+        # Reference: Channel.isValidName — [a-zA-Z0-9-] and 1..16 chars.
+        return (
+            0 < len(name) <= Channel.NAME_MAX
+            and all((c.isascii() and c.isalnum()) or c == "-" for c in name)
+        )
+
+
+@dataclass
+class EngineInstance:
+    """One row per train run (reference: EngineInstance.scala)."""
+
+    id: Optional[str]
+    status: str                                  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    env: Dict[str, str] = field(default_factory=dict)
+    runtime_conf: Dict[str, Any] = field(default_factory=dict)   # reference: sparkConf
+    datasource_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+
+@dataclass
+class EvaluationInstance:
+    """One row per `pio eval` run (reference: EvaluationInstance.scala)."""
+
+    id: Optional[str]
+    status: str
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    evaluation_class: str
+    engine_params_generator_class: str
+    env: Dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""                  # pretty text summary
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = "{}"
+
+
+@dataclass
+class Model:
+    """Binary model blob (reference: Model.scala / Models trait)."""
+
+    id: str
+    models: bytes
+
+
+# --------------------------------------------------------------------------
+# Repository traits
+# --------------------------------------------------------------------------
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]: ...
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> bool: ...
+
+
+# --------------------------------------------------------------------------
+# Events trait — unified L+P event store
+# --------------------------------------------------------------------------
+
+# Columnar schema for the P (training) read path; feeds host-sharded arrays.
+EVENT_ARROW_SCHEMA = pa.schema(
+    [
+        pa.field("event_id", pa.string()),
+        pa.field("event", pa.string()),
+        pa.field("entity_type", pa.string()),
+        pa.field("entity_id", pa.string()),
+        pa.field("target_entity_type", pa.string()),
+        pa.field("target_entity_id", pa.string()),
+        pa.field("properties_json", pa.string()),
+        pa.field("event_time_us", pa.int64()),      # epoch micros UTC
+        pa.field("pr_id", pa.string()),
+        pa.field("creation_time_us", pa.int64()),
+    ]
+)
+
+
+class Events(abc.ABC):
+    """Unified event store trait (reference: LEvents + PEvents).
+
+    All methods take ``app_id`` and optional ``channel_id`` (None = default
+    channel), matching the reference's partitioning.
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Create per-app/channel structures (reference: LEvents.init)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Drop all events of the app/channel (reference: LEvents.remove)."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Insert one event; returns the assigned event id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Time/entity-filtered scan (reference: LEvents.find).
+
+        ``limit=None`` means no limit; ``reversed=True`` returns newest first
+        (only valid when filtering, per reference semantics — here always
+        honored).  Results are ordered by event time.
+        """
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> pa.Table:
+        """Columnar scan for the training path (reference: PEvents.find).
+
+        Default implementation converts the iterator; columnar backends
+        override with a zero-copy path.
+        """
+        return events_to_arrow(
+            self.find(
+                app_id,
+                channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+            )
+        )
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        entity_type: str,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Aggregate ``$set``/``$unset``/``$delete`` into per-entity state.
+
+        Reference: PEventStore.aggregateProperties / LEventAggregator.
+        """
+        from predictionio_tpu.data.event import aggregate_properties as _agg
+
+        by_entity: Dict[str, List[Event]] = {}
+        for ev in self.find(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=["$set", "$unset", "$delete"],
+        ):
+            by_entity.setdefault(ev.entity_id, []).append(ev)
+        out: Dict[str, PropertyMap] = {}
+        for eid, evs in by_entity.items():
+            pm = _agg(evs)
+            if pm is None:
+                continue
+            if required and not all(k in pm for k in required):
+                continue
+            out[eid] = pm
+        return out
+
+
+# --------------------------------------------------------------------------
+# Arrow conversion helpers (shared by backends)
+# --------------------------------------------------------------------------
+
+
+def _epoch_us(dt: _dt.datetime) -> int:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1_000_000)
+
+
+def _from_epoch_us(us: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+
+
+def events_to_arrow(events: Iterable[Event]) -> pa.Table:
+    import json
+
+    cols: Dict[str, list] = {f.name: [] for f in EVENT_ARROW_SCHEMA}
+    for e in events:
+        cols["event_id"].append(e.event_id)
+        cols["event"].append(e.event)
+        cols["entity_type"].append(e.entity_type)
+        cols["entity_id"].append(e.entity_id)
+        cols["target_entity_type"].append(e.target_entity_type)
+        cols["target_entity_id"].append(e.target_entity_id)
+        cols["properties_json"].append(json.dumps(e.properties.to_dict()))
+        cols["event_time_us"].append(_epoch_us(e.event_time))
+        cols["pr_id"].append(e.pr_id)
+        cols["creation_time_us"].append(_epoch_us(e.creation_time))
+    return pa.table(cols, schema=EVENT_ARROW_SCHEMA)
+
+
+def arrow_to_events(table: pa.Table) -> List[Event]:
+    import json
+
+    from predictionio_tpu.data.event import DataMap
+
+    out: List[Event] = []
+    d = table.to_pydict()
+    n = table.num_rows
+    for i in range(n):
+        out.append(
+            Event(
+                event_id=d["event_id"][i],
+                event=d["event"][i],
+                entity_type=d["entity_type"][i],
+                entity_id=d["entity_id"][i],
+                target_entity_type=d["target_entity_type"][i],
+                target_entity_id=d["target_entity_id"][i],
+                properties=DataMap(json.loads(d["properties_json"][i] or "{}")),
+                event_time=_from_epoch_us(d["event_time_us"][i]),
+                pr_id=d["pr_id"][i],
+                creation_time=_from_epoch_us(d["creation_time_us"][i]),
+            )
+        )
+    return out

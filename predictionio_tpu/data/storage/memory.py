@@ -1,0 +1,349 @@
+"""In-memory storage backend — the test double.
+
+Reference analogue: the reference's unit suites use fake/in-memory stores
+(SURVEY.md §4); this backend implements every repository trait so contract
+tests and engine-workflow tests need no filesystem.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+)
+
+__all__ = [
+    "MemoryApps",
+    "MemoryAccessKeys",
+    "MemoryChannels",
+    "MemoryEngineInstances",
+    "MemoryEvaluationInstances",
+    "MemoryModels",
+    "MemoryEvents",
+]
+
+
+class MemoryApps(base.Apps):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._apps: Dict[int, App] = {}
+        self._next = itertools.count(1)
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            if any(a.name == app.name for a in self._apps.values()):
+                return None
+            if app.id is not None:
+                app_id = app.id
+                if app_id in self._apps:
+                    return None
+            else:
+                app_id = next(self._next)
+                while app_id in self._apps:  # skip past explicitly-taken ids
+                    app_id = next(self._next)
+            self._apps[app_id] = App(id=app_id, name=app.name, description=app.description)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return next((a for a in self._apps.values() if a.name == name), None)
+
+    def get_all(self) -> List[App]:
+        return sorted(self._apps.values(), key=lambda a: a.id)
+
+    def update(self, app: App) -> bool:
+        with self._lock:
+            if app.id not in self._apps:
+                return False
+            self._apps[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._lock:
+            return self._apps.pop(app_id, None) is not None
+
+
+class MemoryAccessKeys(base.AccessKeys):
+    def __init__(self):
+        self._keys: Dict[str, AccessKey] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        with self._lock:
+            k = access_key.key or AccessKey.generate(access_key.app_id).key
+            if k in self._keys:
+                return None
+            self._keys[k] = AccessKey(key=k, app_id=access_key.app_id, events=tuple(access_key.events))
+            return k
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._keys.get(key)
+
+    def get_all(self) -> List[AccessKey]:
+        return list(self._keys.values())
+
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]:
+        return [k for k in self._keys.values() if k.app_id == app_id]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._lock:
+            if access_key.key not in self._keys:
+                return False
+            self._keys[access_key.key] = access_key
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._keys.pop(key, None) is not None
+
+
+class MemoryChannels(base.Channels):
+    def __init__(self):
+        self._channels: Dict[int, Channel] = {}
+        self._next = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        with self._lock:
+            if any(
+                c.app_id == channel.app_id and c.name == channel.name
+                for c in self._channels.values()
+            ):
+                return None
+            if channel.id is not None:
+                cid = channel.id
+                if cid in self._channels:
+                    return None
+            else:
+                cid = next(self._next)
+                while cid in self._channels:
+                    cid = next(self._next)
+            self._channels[cid] = Channel(id=cid, name=channel.name, app_id=channel.app_id)
+            return cid
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._channels.get(channel_id)
+
+    def get_by_app_id(self, app_id: int) -> List[Channel]:
+        return [c for c in self._channels.values() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._lock:
+            return self._channels.pop(channel_id, None) is not None
+
+
+class MemoryEngineInstances(base.EngineInstances):
+    def __init__(self):
+        self._instances: Dict[str, EngineInstance] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, instance: EngineInstance) -> str:
+        with self._lock:
+            iid = instance.id or uuid.uuid4().hex
+            instance.id = iid
+            self._instances[iid] = instance
+            return iid
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        return self._instances.get(instance_id)
+
+    def get_all(self) -> List[EngineInstance]:
+        return list(self._instances.values())
+
+    def _completed(self, engine_id, engine_version, engine_variant):
+        return sorted(
+            (
+                i
+                for i in self._instances.values()
+                if i.status == "COMPLETED"
+                and i.engine_id == engine_id
+                and i.engine_version == engine_version
+                and i.engine_variant == engine_variant
+            ),
+            key=lambda i: i.start_time,
+            reverse=True,
+        )
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        c = self._completed(engine_id, engine_version, engine_variant)
+        return c[0] if c else None
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return self._completed(engine_id, engine_version, engine_variant)
+
+    def update(self, instance: EngineInstance) -> bool:
+        with self._lock:
+            if instance.id not in self._instances:
+                return False
+            self._instances[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._instances.pop(instance_id, None) is not None
+
+
+class MemoryEvaluationInstances(base.EvaluationInstances):
+    def __init__(self):
+        self._instances: Dict[str, EvaluationInstance] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        with self._lock:
+            iid = instance.id or uuid.uuid4().hex
+            instance.id = iid
+            self._instances[iid] = instance
+            return iid
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        return self._instances.get(instance_id)
+
+    def get_all(self) -> List[EvaluationInstance]:
+        return list(self._instances.values())
+
+    def get_completed(self) -> List[EvaluationInstance]:
+        return sorted(
+            (i for i in self._instances.values() if i.status == "EVALCOMPLETED"),
+            key=lambda i: i.start_time,
+            reverse=True,
+        )
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        with self._lock:
+            if instance.id not in self._instances:
+                return False
+            self._instances[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._instances.pop(instance_id, None) is not None
+
+
+class MemoryModels(base.Models):
+    def __init__(self):
+        self._models: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, model: Model) -> None:
+        with self._lock:
+            self._models[model.id] = model.models
+
+    def get(self, model_id: str) -> Optional[Model]:
+        blob = self._models.get(model_id)
+        return Model(id=model_id, models=blob) if blob is not None else None
+
+    def delete(self, model_id: str) -> bool:
+        with self._lock:
+            return self._models.pop(model_id, None) is not None
+
+
+def _match(
+    ev: Event,
+    start_time,
+    until_time,
+    entity_type,
+    entity_id,
+    event_names,
+    target_entity_type,
+    target_entity_id,
+) -> bool:
+    if start_time is not None and ev.event_time < start_time:
+        return False
+    if until_time is not None and ev.event_time >= until_time:
+        return False
+    if entity_type is not None and ev.entity_type != entity_type:
+        return False
+    if entity_id is not None and ev.entity_id != entity_id:
+        return False
+    if event_names is not None and ev.event not in event_names:
+        return False
+    if target_entity_type is not None and ev.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not None and ev.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+class MemoryEvents(base.Events):
+    def __init__(self):
+        self._store: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+        self._lock = threading.Lock()
+
+    def _bucket(self, app_id: int, channel_id: Optional[int]) -> Dict[str, Event]:
+        key = (app_id, channel_id)
+        if key not in self._store:
+            raise base.StorageError(
+                f"Events store for app {app_id} channel {channel_id} not initialized."
+            )
+        return self._store[key]
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._store.setdefault((app_id, channel_id), {})
+            return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._store.pop((app_id, channel_id), None) is not None
+
+    def close(self) -> None:
+        pass
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        with self._lock:
+            bucket = self._bucket(app_id, channel_id)
+            eid = event.event_id or uuid.uuid4().hex
+            bucket[eid] = event.with_event_id(eid)
+            return eid
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
+        return self._bucket(app_id, channel_id).get(event_id)
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._bucket(app_id, channel_id).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        evs = [
+            e
+            for e in self._bucket(app_id, channel_id).values()
+            if _match(
+                e, start_time, until_time, entity_type, entity_id,
+                event_names, target_entity_type, target_entity_id,
+            )
+        ]
+        evs.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed)
+        if limit is not None and limit >= 0:
+            evs = evs[:limit]
+        return iter(evs)

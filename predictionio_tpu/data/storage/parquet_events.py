@@ -1,0 +1,200 @@
+"""Append-only Parquet event log — the batch-training-optimized event store.
+
+Reference analogue: storage/hbase/ (HBPEvents' full-scan RDD reads) —
+SURVEY.md §2.1.  Where HBase serves Spark `newAPIHadoopRDD` scans, this
+backend serves columnar `pyarrow` scans that feed host-sharded `jax.Array`
+construction directly (zero row materialization on the training path).
+
+Layout: ``<root>/app_<id>/<channel|default>/part-<uuid>.parquet``; one file
+per flushed batch.  Deletion of single events rewrites the owning part file
+(rare path); `remove` drops the directory.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import threading
+import uuid
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import EVENT_ARROW_SCHEMA
+
+__all__ = ["ParquetEvents"]
+
+
+def _us(dt: _dt.datetime) -> int:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1_000_000)
+
+
+class ParquetEvents(base.Events):
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self._lock = threading.RLock()
+
+    def _dir(self, app_id: int, channel_id: Optional[int]) -> Path:
+        chan = "default" if channel_id is None else str(channel_id)
+        return self.root / f"app_{app_id}" / chan
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._dir(app_id, channel_id).mkdir(parents=True, exist_ok=True)
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        import shutil
+
+        d = self._dir(app_id, channel_id)
+        if not d.exists():
+            return False
+        shutil.rmtree(d)
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def _check_init(self, app_id: int, channel_id: Optional[int]) -> Path:
+        d = self._dir(app_id, channel_id)
+        if not d.is_dir():
+            raise base.StorageError(
+                f"Events store for app {app_id} channel {channel_id} not initialized."
+            )
+        return d
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        d = self._check_init(app_id, channel_id)
+        stamped = []
+        ids = []
+        for ev in events:
+            eid = ev.event_id or uuid.uuid4().hex
+            ids.append(eid)
+            stamped.append(ev.with_event_id(eid))
+        table = base.events_to_arrow(stamped)
+        with self._lock:
+            pq.write_table(table, d / f"part-{uuid.uuid4().hex}.parquet")
+        return ids
+
+    def _scan(self, d: Path) -> Optional[pa.Table]:
+        parts = sorted(d.glob("part-*.parquet"))
+        if not parts:
+            return None
+        return pa.concat_tables([pq.read_table(p) for p in parts])
+
+    def _filtered(
+        self, app_id, channel_id, start_time, until_time, entity_type, entity_id,
+        event_names, target_entity_type, target_entity_id,
+    ) -> pa.Table:
+        d = self._check_init(app_id, channel_id)
+        with self._lock:
+            table = self._scan(d)
+        if table is None:
+            return EVENT_ARROW_SCHEMA.empty_table()
+        mask = None
+
+        def _and(m, cond):
+            return cond if m is None else pc.and_(m, cond)
+
+        if start_time is not None:
+            mask = _and(mask, pc.greater_equal(table["event_time_us"], _us(start_time)))
+        if until_time is not None:
+            mask = _and(mask, pc.less(table["event_time_us"], _us(until_time)))
+        if entity_type is not None:
+            mask = _and(mask, pc.equal(table["entity_type"], entity_type))
+        if entity_id is not None:
+            mask = _and(mask, pc.equal(table["entity_id"], entity_id))
+        if event_names is not None:
+            mask = _and(
+                mask,
+                pc.is_in(table["event"],
+                         value_set=pa.array(list(event_names), type=pa.string())),
+            )
+        if target_entity_type is not None:
+            mask = _and(mask, pc.equal(table["target_entity_type"], target_entity_type))
+        if target_entity_id is not None:
+            mask = _and(mask, pc.equal(table["target_entity_id"], target_entity_id))
+        if mask is not None:
+            table = table.filter(mask)
+        return table.sort_by([("event_time_us", "ascending"), ("creation_time_us", "ascending")])
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
+        d = self._check_init(app_id, channel_id)
+        with self._lock:
+            table = self._scan(d)
+        if table is None:
+            return None
+        hit = table.filter(pc.equal(table["event_id"], event_id))
+        if hit.num_rows == 0:
+            return None
+        return base.arrow_to_events(hit)[0]
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        d = self._check_init(app_id, channel_id)
+        with self._lock:
+            for p in sorted(d.glob("part-*.parquet")):
+                t = pq.read_table(p)
+                mask = pc.equal(t["event_id"], event_id)
+                if pc.any(mask).as_py():
+                    kept = t.filter(pc.invert(mask))
+                    if kept.num_rows:
+                        pq.write_table(kept, p)
+                    else:
+                        p.unlink()
+                    return True
+        return False
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        table = self._filtered(
+            app_id, channel_id, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id,
+        )
+        events = base.arrow_to_events(table)
+        if reversed:
+            events.reverse()
+        if limit is not None and limit >= 0:
+            events = events[:limit]
+        return iter(events)
+
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> pa.Table:
+        return self._filtered(
+            app_id, channel_id, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id,
+        )

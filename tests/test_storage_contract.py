@@ -1,0 +1,320 @@
+"""Storage contract tests — one spec, every backend.
+
+Reference: data/.../storage/LEventsSpec / PEventsSpec run against multiple
+backends via env selection (SURVEY.md §4 "storage-contract tests").  Here
+pytest parametrization replaces env selection.
+"""
+
+import datetime as dt
+import json
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage import StorageError
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    Model,
+)
+
+UTC = dt.timezone.utc
+
+
+def ts(s):
+    return dt.datetime.fromisoformat(s).replace(tzinfo=UTC)
+
+
+# --------------------------------------------------------------------------
+# Events contract
+# --------------------------------------------------------------------------
+
+@pytest.fixture(params=["memory", "sqlite", "parquetlog"])
+def events_backend(request, tmp_path):
+    if request.param == "memory":
+        from predictionio_tpu.data.storage.memory import MemoryEvents
+
+        yield MemoryEvents()
+    elif request.param == "sqlite":
+        from predictionio_tpu.data.storage.sqlite import SQLiteClient
+
+        client = SQLiteClient(str(tmp_path / "ev.db"))
+        yield client.events()
+        client.close()
+    else:
+        from predictionio_tpu.data.storage.parquet_events import ParquetEvents
+
+        yield ParquetEvents(str(tmp_path / "events"))
+
+
+def _mk(event, eid, t, etype="user", target=None, props=None):
+    return Event(
+        event=event,
+        entity_type=etype,
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=ts(t),
+    )
+
+
+APP = 7
+
+
+class TestEventsContract:
+    def test_requires_init(self, events_backend):
+        with pytest.raises(StorageError):
+            list(events_backend.find(APP))
+
+    def test_insert_get_delete(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        eid = ev.insert(_mk("rate", "u1", "2026-01-01T00:00:00", target="i1",
+                            props={"rating": 4.5}), APP)
+        got = ev.get(eid, APP)
+        assert got is not None
+        assert got.event == "rate" and got.entity_id == "u1"
+        assert got.target_entity_id == "i1"
+        assert got.properties.get_double("rating") == 4.5
+        assert got.event_time == ts("2026-01-01T00:00:00")
+        assert ev.delete(eid, APP) is True
+        assert ev.get(eid, APP) is None
+        assert ev.delete(eid, APP) is False
+
+    def test_find_filters_and_order(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("view", "u1", "2026-01-01T00:00:00", target="i1"),
+                _mk("buy", "u1", "2026-01-02T00:00:00", target="i2"),
+                _mk("view", "u2", "2026-01-03T00:00:00", target="i1"),
+                _mk("view", "u1", "2026-01-04T00:00:00", target="i3"),
+            ],
+            APP,
+        )
+        all_ev = list(ev.find(APP))
+        assert [e.event_time for e in all_ev] == sorted(e.event_time for e in all_ev)
+        assert len(all_ev) == 4
+        u1 = list(ev.find(APP, entity_type="user", entity_id="u1"))
+        assert len(u1) == 3
+        views = list(ev.find(APP, event_names=["view"]))
+        assert len(views) == 3
+        window = list(
+            ev.find(APP, start_time=ts("2026-01-02T00:00:00"),
+                    until_time=ts("2026-01-04T00:00:00"))
+        )
+        assert [e.event for e in window] == ["buy", "view"]
+        tgt = list(ev.find(APP, target_entity_type="item", target_entity_id="i1"))
+        assert len(tgt) == 2
+        newest = list(ev.find(APP, limit=2, reversed=True))
+        assert [e.event_time for e in newest] == [ts("2026-01-04T00:00:00"),
+                                                  ts("2026-01-03T00:00:00")]
+
+    def test_channel_isolation(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.init(APP, channel_id=2)
+        ev.insert(_mk("view", "u1", "2026-01-01T00:00:00"), APP)
+        ev.insert(_mk("buy", "u1", "2026-01-02T00:00:00"), APP, channel_id=2)
+        assert [e.event for e in ev.find(APP)] == ["view"]
+        assert [e.event for e in ev.find(APP, channel_id=2)] == ["buy"]
+
+    def test_remove(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.insert(_mk("view", "u1", "2026-01-01T00:00:00"), APP)
+        assert ev.remove(APP) is True
+        with pytest.raises(StorageError):
+            list(ev.find(APP))
+
+    def test_find_columnar(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("rate", "u1", "2026-01-01T00:00:00", target="i1", props={"r": 1.0}),
+                _mk("rate", "u2", "2026-01-02T00:00:00", target="i2", props={"r": 2.0}),
+            ],
+            APP,
+        )
+        table = ev.find_columnar(APP, event_names=["rate"])
+        assert table.num_rows == 2
+        assert table.column("entity_id").to_pylist() == ["u1", "u2"]
+        props = [json.loads(p) for p in table.column("properties_json").to_pylist()]
+        assert [p["r"] for p in props] == [1.0, 2.0]
+
+    def test_aggregate_properties(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("$set", "i1", "2026-01-01T00:00:00", etype="item",
+                    props={"cat": "a", "price": 10}),
+                _mk("$set", "i1", "2026-01-02T00:00:00", etype="item", props={"price": 12}),
+                _mk("$set", "i2", "2026-01-01T00:00:00", etype="item", props={"cat": "b"}),
+                _mk("$delete", "i2", "2026-01-03T00:00:00", etype="item"),
+                _mk("view", "u1", "2026-01-02T00:00:00"),
+            ],
+            APP,
+        )
+        props = ev.aggregate_properties(APP, entity_type="item")
+        assert set(props) == {"i1"}
+        assert props["i1"].to_dict() == {"cat": "a", "price": 12}
+
+
+# --------------------------------------------------------------------------
+# Metadata contract
+# --------------------------------------------------------------------------
+
+@pytest.fixture(params=["memory", "sqlite"])
+def meta_backend(request, tmp_path):
+    if request.param == "memory":
+        from predictionio_tpu.data.storage import memory as m
+
+        class B:
+            apps = m.MemoryApps()
+            keys = m.MemoryAccessKeys()
+            channels = m.MemoryChannels()
+            instances = m.MemoryEngineInstances()
+            models = m.MemoryModels()
+
+        yield B
+    else:
+        from predictionio_tpu.data.storage.sqlite import SQLiteClient
+
+        client = SQLiteClient(str(tmp_path / "meta.db"))
+
+        class B:
+            apps = client.apps()
+            keys = client.access_keys()
+            channels = client.channels()
+            instances = client.engine_instances()
+            models = client.models()
+
+        yield B
+        client.close()
+
+
+class TestMetadataContract:
+    def test_apps_crud(self, meta_backend):
+        apps = meta_backend.apps
+        aid = apps.insert(App(id=None, name="myapp", description="d"))
+        assert aid is not None
+        assert apps.get(aid).name == "myapp"
+        assert apps.get_by_name("myapp").id == aid
+        assert apps.insert(App(id=None, name="myapp")) is None  # duplicate name
+        assert apps.update(App(id=aid, name="renamed", description=None))
+        assert apps.get(aid).name == "renamed"
+        assert [a.id for a in apps.get_all()] == [aid]
+        assert apps.delete(aid) is True
+        assert apps.get(aid) is None
+
+    def test_access_keys(self, meta_backend):
+        keys = meta_backend.keys
+        k = keys.insert(AccessKey(key="", app_id=3, events=("view",)))
+        assert k
+        got = keys.get(k)
+        assert got.app_id == 3 and got.events == ("view",)
+        assert keys.get_by_app_id(3)[0].key == k
+        assert keys.delete(k) is True
+        assert keys.get(k) is None
+
+    def test_channels(self, meta_backend):
+        ch = meta_backend.channels
+        cid = ch.insert(Channel(id=None, name="live", app_id=3))
+        assert cid is not None
+        assert ch.get(cid).name == "live"
+        # invalid name (too long / bad chars) rejected
+        assert ch.insert(Channel(id=None, name="x" * 17, app_id=3)) is None
+        assert ch.insert(Channel(id=None, name="bad name", app_id=3)) is None
+        # duplicate per app rejected
+        assert ch.insert(Channel(id=None, name="live", app_id=3)) is None
+        assert [c.id for c in ch.get_by_app_id(3)] == [cid]
+        assert ch.delete(cid) is True
+
+    def test_engine_instances_lifecycle(self, meta_backend):
+        insts = meta_backend.instances
+
+        def mk(status, t):
+            return EngineInstance(
+                id=None, status=status, start_time=ts(t), end_time=None,
+                engine_id="e1", engine_version="v1", engine_variant="default",
+                engine_factory="my.Factory",
+                algorithms_params='[{"name":"als","params":{"rank":8}}]',
+            )
+
+        i1 = insts.insert(mk("TRAINING", "2026-01-01T00:00:00"))
+        i2 = insts.insert(mk("COMPLETED", "2026-01-02T00:00:00"))
+        i3 = insts.insert(mk("COMPLETED", "2026-01-03T00:00:00"))
+        assert insts.get_latest_completed("e1", "v1", "default").id == i3
+        assert [i.id for i in insts.get_completed("e1", "v1", "default")] == [i3, i2]
+        inst = insts.get(i1)
+        inst.status = "FAILED"
+        inst.end_time = ts("2026-01-01T01:00:00")
+        assert insts.update(inst)
+        assert insts.get(i1).status == "FAILED"
+        assert insts.get(i1).end_time == ts("2026-01-01T01:00:00")
+        assert json.loads(insts.get(i2).algorithms_params)[0]["params"]["rank"] == 8
+        assert insts.delete(i1)
+
+    def test_models_blob(self, meta_backend):
+        models = meta_backend.models
+        models.insert(Model(id="m1", models=b"\x00\x01binary"))
+        assert models.get("m1").models == b"\x00\x01binary"
+        models.insert(Model(id="m1", models=b"v2"))  # overwrite
+        assert models.get("m1").models == b"v2"
+        assert models.delete("m1") is True
+        assert models.get("m1") is None
+
+
+# --------------------------------------------------------------------------
+# localfs models + registry
+# --------------------------------------------------------------------------
+
+def test_localfs_models(tmp_path):
+    from predictionio_tpu.data.storage.localfs_models import LocalFSModels
+
+    m = LocalFSModels(str(tmp_path / "models"))
+    m.insert(Model(id="engine/inst1", models=b"blob"))
+    assert m.get("engine/inst1").models == b"blob"
+    assert m.delete("engine/inst1") is True
+    assert m.get("engine/inst1") is None
+
+
+def test_storage_registry_defaults(pio_home):
+    from predictionio_tpu.data.storage import Storage
+
+    s = Storage()
+    assert s.verify() == {
+        "METADATA": "sqlite", "EVENTDATA": "sqlite", "MODELDATA": "localfs"
+    }
+    apps = s.get_apps()
+    aid = apps.insert(App(id=None, name="regapp"))
+    ev = s.get_events()
+    ev.init(aid)
+    ev.insert(_mk("view", "u1", "2026-01-01T00:00:00"), aid)
+    assert len(list(ev.find(aid))) == 1
+    s.close()
+
+
+def test_storage_registry_parquet_eventdata(pio_home, monkeypatch):
+    from predictionio_tpu.data.storage import Storage
+
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "PARQUET")
+    s = Storage()
+    assert s.verify()["EVENTDATA"] == "parquetlog"
+    s.close()
+
+
+def test_storage_registry_unknown_type(pio_home, monkeypatch):
+    from predictionio_tpu.data.storage import Storage
+
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_BOGUS_TYPE", "nosuch")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "BOGUS")
+    s = Storage()
+    with pytest.raises(StorageError):
+        s.get_apps()

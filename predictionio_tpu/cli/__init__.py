@@ -1,0 +1,1 @@
+"""`pio` CLI (reference: tools/src/main/scala/org/apache/predictionio/tools/)."""

@@ -1,0 +1,430 @@
+"""The `pio` command-line console.
+
+Reference: tools/.../tools/console/Console.scala (scopt verb dispatch) and
+tools/.../commands/{App,AccessKey,...} — SURVEY.md §2.1 "Tools/CLI" and
+Appendix A's CLI verb list.  Verbs:
+
+    pio status
+    pio app new <name> | list | delete <name> | data-delete <name>
+    pio app channel-new <app> <channel> | channel-delete <app> <channel>
+    pio accesskey new <appname> [event ...] | list [appname] | delete <key>
+    pio train   --engine-json engine.json [--seed N]
+    pio import  --appid N --input events.ndjson
+    pio export  --appid N --output events.ndjson
+    pio eval    <EvaluationClass> <EngineParamsGeneratorClass>
+    pio eventserver --port 7070        (added with the server layer)
+    pio deploy  --engine-json ... --port 8000
+
+Where the reference's `pio train`/`pio deploy` shell out to spark-submit,
+these run the workflow in-process — there is no cluster-manager boundary on
+a TPU slice; multi-host launch is `pio train` once per host with
+PIO_COORDINATOR_ADDRESS set (parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as _dt
+import json
+import logging
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from predictionio_tpu.version import __version__
+
+logger = logging.getLogger(__name__)
+
+
+def _storage():
+    from predictionio_tpu.data.storage import get_storage
+
+    return get_storage()
+
+
+def _die(msg: str, code: int = 1) -> "NoReturn":  # noqa: F821
+    print(f"[error] {msg}", file=sys.stderr)
+    raise SystemExit(code)
+
+
+# --------------------------------------------------------------------------
+# pio status
+# --------------------------------------------------------------------------
+
+def cmd_status(args) -> int:
+    from predictionio_tpu.config import load_config
+
+    cfg = load_config()
+    print(f"predictionio_tpu {__version__}")
+    print(f"PIO_HOME: {cfg.home}")
+    try:
+        repo_types = _storage().verify()
+    except Exception as e:
+        _die(f"storage verification failed: {e}")
+    for repo, t in repo_types.items():
+        src = cfg.source_for(repo)
+        print(f"  {repo}: type={t} path={src.path or '-'}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"devices: {len(devs)} x {devs[0].platform if devs else '-'}"
+              f" ({devs[0].device_kind if devs else '-'})")
+    except Exception as e:  # TPU tunnel may be down; status should still work
+        print(f"devices: unavailable ({e})")
+    print("(sanity check OK)")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# pio app ...
+# --------------------------------------------------------------------------
+
+def cmd_app_new(args) -> int:
+    from predictionio_tpu.data.storage import AccessKey, App
+
+    s = _storage()
+    app_id = s.get_apps().insert(App(id=None, name=args.name, description=args.description))
+    if app_id is None:
+        _die(f"App {args.name!r} already exists.")
+    s.get_events().init(app_id)
+    key = s.get_access_keys().insert(AccessKey(key=args.access_key or "", app_id=app_id))
+    print("Created a new app:")
+    print(f"      Name: {args.name}")
+    print(f"        ID: {app_id}")
+    print(f"Access Key: {key}")
+    return 0
+
+
+def cmd_app_list(args) -> int:
+    s = _storage()
+    apps = s.get_apps().get_all()
+    keys = s.get_access_keys()
+    print(f"{'Name':20} {'ID':>4}  Access Key")
+    for app in apps:
+        ks = keys.get_by_app_id(app.id)
+        first = ks[0].key if ks else "-"
+        print(f"{app.name:20} {app.id:>4}  {first}")
+    print(f"Finished listing {len(apps)} app(s).")
+    return 0
+
+
+def cmd_app_delete(args) -> int:
+    s = _storage()
+    app = s.get_apps().get_by_name(args.name)
+    if app is None:
+        _die(f"App {args.name!r} does not exist.")
+    if not args.force:
+        ans = input(f"Delete app {args.name!r} and ALL its data? (YES to confirm): ")
+        if ans.strip() != "YES":
+            print("Aborted.")
+            return 1
+    for ch in s.get_channels().get_by_app_id(app.id):
+        s.get_events().remove(app.id, ch.id)
+        s.get_channels().delete(ch.id)
+    s.get_events().remove(app.id)
+    for k in s.get_access_keys().get_by_app_id(app.id):
+        s.get_access_keys().delete(k.key)
+    s.get_apps().delete(app.id)
+    print(f"Deleted app {args.name}.")
+    return 0
+
+
+def cmd_app_data_delete(args) -> int:
+    s = _storage()
+    app = s.get_apps().get_by_name(args.name)
+    if app is None:
+        _die(f"App {args.name!r} does not exist.")
+    channel_id = None
+    if args.channel:
+        chans = s.get_channels().get_by_app_id(app.id)
+        ch = next((c for c in chans if c.name == args.channel), None)
+        if ch is None:
+            _die(f"Channel {args.channel!r} does not exist in app {args.name!r}.")
+        channel_id = ch.id
+    if not args.force:
+        where = f"channel {args.channel!r} of " if args.channel else ""
+        ans = input(f"Delete all event data of {where}app {args.name!r}? (YES to confirm): ")
+        if ans.strip() != "YES":
+            print("Aborted.")
+            return 1
+    ev = s.get_events()
+    ev.remove(app.id, channel_id)
+    ev.init(app.id, channel_id)
+    print("Event data deleted.")
+    return 0
+
+
+def cmd_app_channel_new(args) -> int:
+    from predictionio_tpu.data.storage import Channel
+
+    s = _storage()
+    app = s.get_apps().get_by_name(args.app)
+    if app is None:
+        _die(f"App {args.app!r} does not exist.")
+    cid = s.get_channels().insert(Channel(id=None, name=args.channel, app_id=app.id))
+    if cid is None:
+        _die(f"Invalid or duplicate channel name {args.channel!r} "
+             "(1-16 chars, [a-zA-Z0-9-]).")
+    s.get_events().init(app.id, cid)
+    print(f"Created channel {args.channel} (ID {cid}) in app {args.app}.")
+    return 0
+
+
+def cmd_app_channel_delete(args) -> int:
+    s = _storage()
+    app = s.get_apps().get_by_name(args.app)
+    if app is None:
+        _die(f"App {args.app!r} does not exist.")
+    ch = next((c for c in s.get_channels().get_by_app_id(app.id)
+               if c.name == args.channel), None)
+    if ch is None:
+        _die(f"Channel {args.channel!r} does not exist in app {args.app!r}.")
+    s.get_events().remove(app.id, ch.id)
+    s.get_channels().delete(ch.id)
+    print(f"Deleted channel {args.channel} from app {args.app}.")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# pio accesskey ...
+# --------------------------------------------------------------------------
+
+def cmd_accesskey_new(args) -> int:
+    from predictionio_tpu.data.storage import AccessKey
+
+    s = _storage()
+    app = s.get_apps().get_by_name(args.app)
+    if app is None:
+        _die(f"App {args.app!r} does not exist.")
+    key = s.get_access_keys().insert(
+        AccessKey(key="", app_id=app.id, events=tuple(args.events))
+    )
+    print(f"Created new access key: {key}")
+    if args.events:
+        print(f"  (restricted to events: {', '.join(args.events)})")
+    return 0
+
+
+def cmd_accesskey_list(args) -> int:
+    s = _storage()
+    keys = s.get_access_keys()
+    if args.app:
+        app = s.get_apps().get_by_name(args.app)
+        if app is None:
+            _die(f"App {args.app!r} does not exist.")
+        rows = keys.get_by_app_id(app.id)
+    else:
+        rows = keys.get_all()
+    for k in rows:
+        ev = ",".join(k.events) if k.events else "(all)"
+        print(f"{k.key}  app={k.app_id}  events={ev}")
+    print(f"Finished listing {len(rows)} access key(s).")
+    return 0
+
+
+def cmd_accesskey_delete(args) -> int:
+    if not _storage().get_access_keys().delete(args.key):
+        _die(f"Access key {args.key!r} does not exist.")
+    print("Deleted access key.")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# pio train / eval
+# --------------------------------------------------------------------------
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.controller import EngineVariant, RuntimeContext, load_engine_factory
+    from predictionio_tpu.workflow import run_train
+
+    variant_path = Path(args.engine_json)
+    if not variant_path.exists():
+        _die(f"{variant_path} not found (expected an engine.json).")
+    variant = EngineVariant.from_file(variant_path)
+    engine = load_engine_factory(variant.engine_factory)()
+    ctx = RuntimeContext.create(seed=args.seed)
+    instance_id = run_train(engine, variant, ctx)
+    print(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.controller import load_engine_factory, RuntimeContext
+    from predictionio_tpu.workflow import run_evaluation
+
+    evaluation = load_engine_factory(args.evaluation_class)()
+    generator = load_engine_factory(args.params_generator_class)()
+    ctx = RuntimeContext.create(seed=args.seed)
+    instance_id, result = run_evaluation(
+        evaluation,
+        generator,
+        ctx,
+        evaluation_class=args.evaluation_class,
+        params_generator_class=args.params_generator_class,
+    )
+    print(result.summary())
+    print(f"Evaluation instance ID: {instance_id}")
+    if args.output_json:
+        inst = ctx.storage.get_evaluation_instances().get(instance_id)
+        Path(args.output_json).write_text(inst.evaluator_results_json)
+        print(f"Results written to {args.output_json}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# pio import / export
+# --------------------------------------------------------------------------
+
+def cmd_import(args) -> int:
+    from predictionio_tpu.data.json_support import event_from_json
+
+    s = _storage()
+    events = []
+    with open(args.input) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(event_from_json(json.loads(line)))
+            except Exception as e:
+                _die(f"{args.input}:{line_no}: {e}")
+    channel_id = _resolve_channel(s, args.appid, args.channel)
+    ev = s.get_events()
+    ev.init(args.appid, channel_id)
+    ids = ev.insert_batch(events, args.appid, channel_id)
+    print(f"Imported {len(ids)} events to app {args.appid}.")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from predictionio_tpu.data.json_support import event_to_json
+
+    s = _storage()
+    channel_id = _resolve_channel(s, args.appid, args.channel)
+    n = 0
+    with open(args.output, "w") as f:
+        for ev in s.get_events().find(args.appid, channel_id):
+            f.write(json.dumps(event_to_json(ev)) + "\n")
+            n += 1
+    print(f"Exported {n} events from app {args.appid} to {args.output}.")
+    return 0
+
+
+def _resolve_channel(s, app_id: int, channel_name: Optional[str]) -> Optional[int]:
+    if not channel_name:
+        return None
+    ch = next((c for c in s.get_channels().get_by_app_id(app_id)
+               if c.name == channel_name), None)
+    if ch is None:
+        _die(f"Channel {channel_name!r} does not exist in app {app_id}.")
+    return ch.id
+
+
+# --------------------------------------------------------------------------
+# parser
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="predictionio_tpu console"
+    )
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    sub.add_parser("status", help="storage + device sanity check").set_defaults(fn=cmd_status)
+
+    app = sub.add_parser("app", help="app management").add_subparsers(
+        dest="app_verb", required=True
+    )
+    a = app.add_parser("new")
+    a.add_argument("name")
+    a.add_argument("--description")
+    a.add_argument("--access-key", dest="access_key")
+    a.set_defaults(fn=cmd_app_new)
+    app.add_parser("list").set_defaults(fn=cmd_app_list)
+    a = app.add_parser("delete")
+    a.add_argument("name")
+    a.add_argument("-f", "--force", action="store_true")
+    a.set_defaults(fn=cmd_app_delete)
+    a = app.add_parser("data-delete")
+    a.add_argument("name")
+    a.add_argument("--channel")
+    a.add_argument("-f", "--force", action="store_true")
+    a.set_defaults(fn=cmd_app_data_delete)
+    a = app.add_parser("channel-new")
+    a.add_argument("app")
+    a.add_argument("channel")
+    a.set_defaults(fn=cmd_app_channel_new)
+    a = app.add_parser("channel-delete")
+    a.add_argument("app")
+    a.add_argument("channel")
+    a.set_defaults(fn=cmd_app_channel_delete)
+
+    ak = sub.add_parser("accesskey", help="access key management").add_subparsers(
+        dest="ak_verb", required=True
+    )
+    a = ak.add_parser("new")
+    a.add_argument("app")
+    a.add_argument("events", nargs="*")
+    a.set_defaults(fn=cmd_accesskey_new)
+    a = ak.add_parser("list")
+    a.add_argument("app", nargs="?")
+    a.set_defaults(fn=cmd_accesskey_list)
+    a = ak.add_parser("delete")
+    a.add_argument("key")
+    a.set_defaults(fn=cmd_accesskey_delete)
+
+    t = sub.add_parser("train", help="train an engine variant")
+    t.add_argument("--engine-json", default="engine.json")
+    t.add_argument("--seed", type=int, default=0)
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("eval", help="evaluate engine-params candidates")
+    e.add_argument("evaluation_class")
+    e.add_argument("params_generator_class")
+    e.add_argument("--seed", type=int, default=0)
+    e.add_argument("--output-json", dest="output_json")
+    e.set_defaults(fn=cmd_eval)
+
+    imp = sub.add_parser("import", help="import NDJSON events")
+    imp.add_argument("--appid", type=int, required=True)
+    imp.add_argument("--channel")
+    imp.add_argument("--input", required=True)
+    imp.set_defaults(fn=cmd_import)
+
+    exp = sub.add_parser("export", help="export events as NDJSON")
+    exp.add_argument("--appid", type=int, required=True)
+    exp.add_argument("--channel")
+    exp.add_argument("--output", required=True)
+    exp.set_defaults(fn=cmd_export)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
+    from predictionio_tpu.controller import ParamsBindingError
+    from predictionio_tpu.data.storage import StorageError
+    from predictionio_tpu.workflow import WorkflowError
+
+    try:
+        return args.fn(args)
+    except SystemExit:
+        raise
+    except KeyboardInterrupt:
+        return 130
+    except (ParamsBindingError, StorageError, WorkflowError) as e:
+        # User-input errors get a clean message; unexpected ones traceback.
+        print(f"[error] {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""DASE base classes — the engine-author SDK.
+
+Reference: core/src/main/scala/org/apache/predictionio/controller/ over the
+SPI in core/.../core/ (BaseDataSource, BasePreparator, BaseAlgorithm,
+BaseServing, BaseEvaluator — SURVEY.md §2.1).
+
+Substrate mapping: where the reference passes a ``SparkContext`` as the
+first argument of every role, we pass a :class:`RuntimeContext` carrying the
+storage handle, the event store, and the JAX device mesh.  The reference's
+``P*``/``L*`` split (RDD vs local collections) collapses: training data is
+whatever the DataSource returns — typically columnar arrays destined for
+sharded ``jax.Array`` construction.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import pickle
+from typing import Any, Dict, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+from predictionio_tpu.controller.params import EmptyParams, Params
+
+__all__ = [
+    "RuntimeContext",
+    "DataSource",
+    "Preparator",
+    "IdentityPreparator",
+    "Algorithm",
+    "Serving",
+    "FirstServing",
+    "PersistentModel",
+    "model_to_bytes",
+    "model_from_bytes",
+]
+
+TD = TypeVar("TD")   # training data
+PD = TypeVar("PD")   # prepared data
+M = TypeVar("M")     # model
+Q = TypeVar("Q")     # query
+P = TypeVar("P")     # predicted result
+A = TypeVar("A")     # actual result
+EI = TypeVar("EI")   # evaluation info
+
+
+@dataclasses.dataclass
+class RuntimeContext:
+    """What a DASE role gets instead of the reference's SparkContext.
+
+    - ``storage``: the configured :class:`~predictionio_tpu.data.storage.Storage`
+    - ``event_store``: name-resolving read API
+      (:class:`~predictionio_tpu.data.store.EventStore`)
+    - ``mesh``: the JAX device mesh for sharded compute (None = single device)
+    - ``seed``: base RNG seed for the run (reproducible training)
+    """
+
+    storage: Any = None
+    event_store: Any = None
+    mesh: Any = None
+    seed: int = 0
+    workflow_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def create(storage=None, mesh=None, seed: int = 0, **workflow_params) -> "RuntimeContext":
+        from predictionio_tpu.data.store import EventStore
+        from predictionio_tpu.data.storage import get_storage
+
+        storage = storage or get_storage()
+        return RuntimeContext(
+            storage=storage,
+            event_store=EventStore(storage),
+            mesh=mesh,
+            seed=seed,
+            workflow_params=dict(workflow_params),
+        )
+
+
+class _HasParams:
+    """Every DASE role is constructed with its Params (reference: Doer)."""
+
+    params_class: type = EmptyParams
+
+    def __init__(self, params: Optional[Params] = None):
+        self.params = params if params is not None else self.params_class()
+
+
+class DataSource(_HasParams, Generic[TD, EI, Q, A], abc.ABC):
+    """Reference: PDataSource/LDataSource — reads training and eval data."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx: RuntimeContext) -> TD: ...
+
+    def read_eval(self, ctx: RuntimeContext) -> List[Tuple[TD, EI, List[Tuple[Q, A]]]]:
+        """K folds of (training data, eval info, [(query, actual)]).
+
+        Reference: PDataSource.readEval.  Default: no eval support.
+        """
+        return []
+
+
+class Preparator(_HasParams, Generic[TD, PD], abc.ABC):
+    """Reference: PPreparator/LPreparator."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: RuntimeContext, training_data: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator[TD, TD]):
+    """Reference: IdentityPreparator — passes training data through."""
+
+    def prepare(self, ctx: RuntimeContext, training_data: TD) -> TD:
+        return training_data
+
+
+class Algorithm(_HasParams, Generic[PD, M, Q, P], abc.ABC):
+    """Reference: PAlgorithm/P2LAlgorithm/LAlgorithm.
+
+    The three reference flavors differ only in where the model lives (RDD vs
+    local); on TPU the model is (sharded) ``jax.Array`` pytrees either way,
+    so one class suffices.
+    """
+
+    @abc.abstractmethod
+    def train(self, ctx: RuntimeContext, prepared_data: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> P: ...
+
+    def batch_predict(self, model: M, queries: Sequence[Tuple[int, Q]]) -> List[Tuple[int, P]]:
+        """Reference: PAlgorithm.batchPredict (used by evaluation).
+
+        Default maps :meth:`predict`; algorithms override with a vectorized
+        XLA path when the per-query loop matters.
+        """
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class Serving(_HasParams, Generic[Q, P], abc.ABC):
+    """Reference: LServing — combine predictions of all algorithms."""
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P: ...
+
+    def supplement(self, query: Q) -> Q:
+        """Reference: LServing.supplement hook — enrich query pre-predict."""
+        return query
+
+
+class FirstServing(Serving[Q, P]):
+    """Reference: FirstServing — returns the first algorithm's prediction."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class PersistentModel(abc.ABC):
+    """Opt-in custom model persistence (reference: PersistentModel +
+    PersistentModelLoader).
+
+    Models that don't implement this are pickled into the MODELDATA blob
+    store keyed by engine-instance id.  Implement for sharded/orbax
+    checkpoints that shouldn't round-trip through a single blob.
+    """
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, ctx: RuntimeContext) -> bool:
+        """Persist under ``instance_id``; return False to fall back to pickle."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params, ctx: RuntimeContext) -> "PersistentModel":
+        ...
+
+
+def model_to_bytes(model: Any) -> bytes:
+    """Default model serialization (reference: P2L/L auto-persistence).
+
+    JAX arrays pickle fine via numpy conversion done by their reducers;
+    engines with exotic state implement PersistentModel instead.
+    """
+    return pickle.dumps(model, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def model_from_bytes(blob: bytes) -> Any:
+    return pickle.loads(blob)

@@ -179,8 +179,15 @@ class AccessKeys(abc.ABC):
 
 
 class Channels(abc.ABC):
+    def insert(self, channel: Channel) -> Optional[int]:
+        """Validate then store; name rules enforced here so every backend —
+        including ones registered via ``register_backend`` — gets them."""
+        if not Channel.is_valid_name(channel.name):
+            return None
+        return self._insert(channel)
+
     @abc.abstractmethod
-    def insert(self, channel: Channel) -> Optional[int]: ...
+    def _insert(self, channel: Channel) -> Optional[int]: ...
 
     @abc.abstractmethod
     def get(self, channel_id: int) -> Optional[Channel]: ...
@@ -291,7 +298,9 @@ class Events(abc.ABC):
 
     @abc.abstractmethod
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
-        """Insert one event; returns the assigned event id."""
+        """Insert one event; the store ALWAYS assigns a fresh event id
+        (any ``event.event_id`` present is ignored), matching the
+        reference's server-generated ids.  Returns the assigned id."""
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
@@ -401,18 +410,28 @@ class Events(abc.ABC):
 
 
 # --------------------------------------------------------------------------
-# Arrow conversion helpers (shared by backends)
+# Timestamp + Arrow conversion helpers (shared by all backends — keep the
+# naive-datetime-is-UTC rule in exactly one place)
 # --------------------------------------------------------------------------
 
 
-def _epoch_us(dt: _dt.datetime) -> int:
+def epoch_us(dt: Optional[_dt.datetime]) -> Optional[int]:
+    if dt is None:
+        return None
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=_dt.timezone.utc)
     return int(dt.timestamp() * 1_000_000)
 
 
-def _from_epoch_us(us: int) -> _dt.datetime:
+def from_epoch_us(us: Optional[int]) -> Optional[_dt.datetime]:
+    if us is None:
+        return None
     return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+
+
+# Backwards-compat private aliases used inside this module.
+_epoch_us = epoch_us
+_from_epoch_us = from_epoch_us
 
 
 def events_to_arrow(events: Iterable[Event]) -> pa.Table:

@@ -7,6 +7,7 @@ tests and engine-workflow tests need no filesystem.
 
 from __future__ import annotations
 
+import copy
 import datetime as _dt
 import itertools
 import threading
@@ -57,19 +58,19 @@ class MemoryApps(base.Apps):
             return app_id
 
     def get(self, app_id: int) -> Optional[App]:
-        return self._apps.get(app_id)
+        return copy.copy(self._apps.get(app_id))
 
     def get_by_name(self, name: str) -> Optional[App]:
-        return next((a for a in self._apps.values() if a.name == name), None)
+        return copy.copy(next((a for a in self._apps.values() if a.name == name), None))
 
     def get_all(self) -> List[App]:
-        return sorted(self._apps.values(), key=lambda a: a.id)
+        return sorted((copy.copy(a) for a in self._apps.values()), key=lambda a: a.id)
 
     def update(self, app: App) -> bool:
         with self._lock:
             if app.id not in self._apps:
                 return False
-            self._apps[app.id] = app
+            self._apps[app.id] = copy.copy(app)
             return True
 
     def delete(self, app_id: int) -> bool:
@@ -117,9 +118,7 @@ class MemoryChannels(base.Channels):
         self._next = itertools.count(1)
         self._lock = threading.Lock()
 
-    def insert(self, channel: Channel) -> Optional[int]:
-        if not Channel.is_valid_name(channel.name):
-            return None
+    def _insert(self, channel: Channel) -> Optional[int]:
         with self._lock:
             if any(
                 c.app_id == channel.app_id and c.name == channel.name
@@ -157,19 +156,21 @@ class MemoryEngineInstances(base.EngineInstances):
         with self._lock:
             iid = instance.id or uuid.uuid4().hex
             instance.id = iid
-            self._instances[iid] = instance
+            # store a snapshot: callers mutating their object must go through
+            # update(), same as on the sqlite backend
+            self._instances[iid] = copy.deepcopy(instance)
             return iid
 
     def get(self, instance_id: str) -> Optional[EngineInstance]:
-        return self._instances.get(instance_id)
+        return copy.deepcopy(self._instances.get(instance_id))
 
     def get_all(self) -> List[EngineInstance]:
-        return list(self._instances.values())
+        return [copy.deepcopy(i) for i in self._instances.values()]
 
     def _completed(self, engine_id, engine_version, engine_variant):
         return sorted(
             (
-                i
+                copy.deepcopy(i)
                 for i in self._instances.values()
                 if i.status == "COMPLETED"
                 and i.engine_id == engine_id
@@ -191,7 +192,7 @@ class MemoryEngineInstances(base.EngineInstances):
         with self._lock:
             if instance.id not in self._instances:
                 return False
-            self._instances[instance.id] = instance
+            self._instances[instance.id] = copy.deepcopy(instance)
             return True
 
     def delete(self, instance_id: str) -> bool:
@@ -208,18 +209,19 @@ class MemoryEvaluationInstances(base.EvaluationInstances):
         with self._lock:
             iid = instance.id or uuid.uuid4().hex
             instance.id = iid
-            self._instances[iid] = instance
+            self._instances[iid] = copy.deepcopy(instance)
             return iid
 
     def get(self, instance_id: str) -> Optional[EvaluationInstance]:
-        return self._instances.get(instance_id)
+        return copy.deepcopy(self._instances.get(instance_id))
 
     def get_all(self) -> List[EvaluationInstance]:
-        return list(self._instances.values())
+        return [copy.deepcopy(i) for i in self._instances.values()]
 
     def get_completed(self) -> List[EvaluationInstance]:
         return sorted(
-            (i for i in self._instances.values() if i.status == "EVALCOMPLETED"),
+            (copy.deepcopy(i) for i in self._instances.values()
+             if i.status == "EVALCOMPLETED"),
             key=lambda i: i.start_time,
             reverse=True,
         )
@@ -228,7 +230,7 @@ class MemoryEvaluationInstances(base.EvaluationInstances):
         with self._lock:
             if instance.id not in self._instances:
                 return False
-            self._instances[instance.id] = instance
+            self._instances[instance.id] = copy.deepcopy(instance)
             return True
 
     def delete(self, instance_id: str) -> bool:
@@ -309,7 +311,7 @@ class MemoryEvents(base.Events):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         with self._lock:
             bucket = self._bucket(app_id, channel_id)
-            eid = event.event_id or uuid.uuid4().hex
+            eid = uuid.uuid4().hex  # store-assigned, any client id ignored
             bucket[eid] = event.with_event_id(eid)
             return eid
 

@@ -37,9 +37,16 @@ def _us(dt: _dt.datetime) -> int:
 
 
 class ParquetEvents(base.Events):
+    """Single-event inserts are buffered in memory and flushed as one part
+    file per :data:`FLUSH_THRESHOLD` events (or on any read/close) — an
+    event-per-file layout would make every scan O(#events) file opens."""
+
+    FLUSH_THRESHOLD = 256
+
     def __init__(self, root: str):
         self.root = Path(root)
         self._lock = threading.RLock()
+        self._pending: Dict[tuple, List[Event]] = {}
 
     def _dir(self, app_id: int, channel_id: Optional[int]) -> Path:
         chan = "default" if channel_id is None else str(channel_id)
@@ -52,14 +59,16 @@ class ParquetEvents(base.Events):
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         import shutil
 
-        d = self._dir(app_id, channel_id)
-        if not d.exists():
-            return False
-        shutil.rmtree(d)
-        return True
+        with self._lock:
+            self._pending.pop((app_id, channel_id), None)
+            d = self._dir(app_id, channel_id)
+            if not d.exists():
+                return False
+            shutil.rmtree(d)
+            return True
 
     def close(self) -> None:
-        pass
+        self.flush()
 
     def _check_init(self, app_id: int, channel_id: Optional[int]) -> Path:
         d = self._dir(app_id, channel_id)
@@ -70,7 +79,14 @@ class ParquetEvents(base.Events):
         return d
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
-        return self.insert_batch([event], app_id, channel_id)[0]
+        self._check_init(app_id, channel_id)
+        eid = uuid.uuid4().hex  # store-assigned, any client id ignored
+        with self._lock:
+            pending = self._pending.setdefault((app_id, channel_id), [])
+            pending.append(event.with_event_id(eid))
+            if len(pending) >= self.FLUSH_THRESHOLD:
+                self._flush(app_id, channel_id)
+        return eid
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
@@ -79,7 +95,7 @@ class ParquetEvents(base.Events):
         stamped = []
         ids = []
         for ev in events:
-            eid = ev.event_id or uuid.uuid4().hex
+            eid = uuid.uuid4().hex
             ids.append(eid)
             stamped.append(ev.with_event_id(eid))
         table = base.events_to_arrow(stamped)
@@ -87,7 +103,25 @@ class ParquetEvents(base.Events):
             pq.write_table(table, d / f"part-{uuid.uuid4().hex}.parquet")
         return ids
 
-    def _scan(self, d: Path) -> Optional[pa.Table]:
+    def _flush(self, app_id: int, channel_id: Optional[int]) -> None:
+        """Write buffered single-event inserts as one part file. Caller holds
+        the lock (RLock: safe from both insert and the read paths)."""
+        pending = self._pending.pop((app_id, channel_id), None)
+        if not pending:
+            return
+        d = self._dir(app_id, channel_id)
+        pq.write_table(base.events_to_arrow(pending),
+                       d / f"part-{uuid.uuid4().hex}.parquet")
+
+    def flush(self) -> None:
+        with self._lock:
+            for app_id, channel_id in list(self._pending):
+                self._flush(app_id, channel_id)
+
+    def _scan(self, d: Path, app_id: int, channel_id: Optional[int]) -> Optional[pa.Table]:
+        """Caller holds the lock; flushes the write buffer first so reads
+        always see every insert."""
+        self._flush(app_id, channel_id)
         parts = sorted(d.glob("part-*.parquet"))
         if not parts:
             return None
@@ -99,7 +133,7 @@ class ParquetEvents(base.Events):
     ) -> pa.Table:
         d = self._check_init(app_id, channel_id)
         with self._lock:
-            table = self._scan(d)
+            table = self._scan(d, app_id, channel_id)
         if table is None:
             return EVENT_ARROW_SCHEMA.empty_table()
         mask = None
@@ -132,7 +166,7 @@ class ParquetEvents(base.Events):
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         d = self._check_init(app_id, channel_id)
         with self._lock:
-            table = self._scan(d)
+            table = self._scan(d, app_id, channel_id)
         if table is None:
             return None
         hit = table.filter(pc.equal(table["event_id"], event_id))
@@ -143,6 +177,7 @@ class ParquetEvents(base.Events):
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
         d = self._check_init(app_id, channel_id)
         with self._lock:
+            self._flush(app_id, channel_id)
             for p in sorted(d.glob("part-*.parquet")):
                 t = pq.read_table(p)
                 mask = pc.equal(t["event_id"], event_id)

@@ -33,18 +33,9 @@ from predictionio_tpu.data.storage.base import (
 __all__ = ["SQLiteClient"]
 
 
-def _us(dt: Optional[_dt.datetime]) -> Optional[int]:
-    if dt is None:
-        return None
-    if dt.tzinfo is None:
-        dt = dt.replace(tzinfo=_dt.timezone.utc)
-    return int(dt.timestamp() * 1_000_000)
-
-
-def _dt_from(us: Optional[int]) -> Optional[_dt.datetime]:
-    if us is None:
-        return None
-    return _dt.datetime.fromtimestamp(us / 1_000_000, tz=_dt.timezone.utc)
+# Single source of truth for the naive-datetime-is-UTC rule lives in base.
+_us = base.epoch_us
+_dt_from = base.from_epoch_us
 
 
 class SQLiteClient:
@@ -294,9 +285,7 @@ class SQLiteAccessKeys(_Repo, base.AccessKeys):
 
 
 class SQLiteChannels(_Repo, base.Channels):
-    def insert(self, channel: Channel) -> Optional[int]:
-        if not Channel.is_valid_name(channel.name):
-            return None
+    def _insert(self, channel: Channel) -> Optional[int]:
         with self._lock:
             try:
                 with self._conn:
@@ -542,7 +531,7 @@ class SQLiteEvents(_Repo, base.Events):
         self._check_init(app_id, channel_id)
         ids, rows = [], []
         for ev in events:
-            eid = ev.event_id or uuid.uuid4().hex
+            eid = uuid.uuid4().hex  # store-assigned, any client id ignored
             ids.append(eid)
             rows.append(
                 (
@@ -639,8 +628,10 @@ class SQLiteEvents(_Repo, base.Events):
         )
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
-        for row in self._conn.execute(sql, params):
-            yield self._row_to_event(row)
+        # Materialize eagerly: errors surface at call time (same as the other
+        # backends) and no cursor outlives the call.
+        rows = self._conn.execute(sql, params).fetchall()
+        return iter([self._row_to_event(r) for r in rows])
 
     def find_columnar(
         self,

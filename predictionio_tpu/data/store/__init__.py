@@ -1,0 +1,160 @@
+"""User-facing event read API used inside engines.
+
+Reference: data/src/main/scala/org/apache/predictionio/data/store/ —
+``PEventStore`` (RDD reads for training) and ``LEventStore`` (iterator reads
+at predict time).  The P path returns `pyarrow` tables here — the host-side
+columnar form that feeds sharded ``jax.Array`` construction (SURVEY.md §7
+build step 3) — instead of RDD partitions.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from predictionio_tpu.data.event import Event, PropertyMap
+from predictionio_tpu.data.storage import Storage, StorageError
+
+__all__ = ["EventStore", "PEventStore", "LEventStore"]
+
+
+class EventStore:
+    """Resolves app/channel names to ids and exposes reads.
+
+    Reference: data/.../data/store/Common.scala (appNameToId) plus the
+    PEventStore/LEventStore objects.  One class serves both roles; the
+    ``P*``/``L*`` aliases below preserve the reference vocabulary.
+    """
+
+    def __init__(self, storage: Storage):
+        self._storage = storage
+
+    def _resolve(self, app_name: str, channel_name: Optional[str]) -> tuple:
+        app = self._storage.get_apps().get_by_name(app_name)
+        if app is None:
+            raise StorageError(f"App {app_name!r} does not exist.")
+        channel_id = None
+        if channel_name is not None:
+            chans = self._storage.get_channels().get_by_app_id(app.id)
+            match = next((c for c in chans if c.name == channel_name), None)
+            if match is None:
+                raise StorageError(
+                    f"Channel {channel_name!r} does not exist in app {app_name!r}."
+                )
+            channel_id = match.id
+        return app.id, channel_id
+
+    # -- P path (training) -------------------------------------------------
+    def find_columnar(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+    ) -> pa.Table:
+        """Columnar batch read (reference: PEventStore.find returning RDD)."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().find_columnar(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Reference: PEventStore.aggregateProperties."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().aggregate_properties(
+            app_id,
+            channel_id,
+            entity_type=entity_type,
+            start_time=start_time,
+            until_time=until_time,
+            required=required,
+        )
+
+    # -- L path (serving) --------------------------------------------------
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        *,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Iterator read (reference: LEventStore.find)."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().find(
+            app_id,
+            channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed=reversed,
+        )
+
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        *,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> List[Event]:
+        """Recent events of one entity (reference: LEventStore.findByEntity),
+        used for realtime business rules at predict time."""
+        return list(
+            self.find(
+                app_name,
+                channel_name,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                limit=limit,
+                reversed=latest,
+            )
+        )
+
+
+# Reference-vocabulary aliases: both stores are views of the same class.
+PEventStore = EventStore
+LEventStore = EventStore

@@ -1,0 +1,31 @@
+"""Mesh, sharding, and collective infrastructure.
+
+Replaces the reference's compute-distribution substrate (Spark executors +
+netty shuffle + spark-submit; SURVEY.md §2.5): here the device mesh IS the
+cluster, XLA collectives over ICI are the shuffle, and `jax.distributed`
+is the control plane.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQUENCE,
+    batch_sharding,
+    cpu_devices_requested,
+    make_mesh,
+    replicated,
+    sharding,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_MODEL",
+    "AXIS_SEQUENCE",
+    "batch_sharding",
+    "cpu_devices_requested",
+    "make_mesh",
+    "replicated",
+    "sharding",
+]

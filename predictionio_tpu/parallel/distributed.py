@@ -1,0 +1,74 @@
+"""Multi-host control-plane bring-up.
+
+Reference: SURVEY.md §2.5 — the reference has no first-party comm layer
+(spark-submit + netty shuffle are external).  The TPU equivalent:
+``jax.distributed.initialize`` forms the multi-host gang (one process per
+host, all chips of a slice in one ``jax.devices()`` view); all data-plane
+traffic is XLA collectives over ICI/DCN — nothing NCCL/MPI-like to hand-roll.
+
+Env contract (subset of the standard JAX one, prefixed for pio):
+
+- ``PIO_COORDINATOR_ADDRESS`` — host:port of process 0
+- ``PIO_NUM_PROCESSES``       — gang size
+- ``PIO_PROCESS_ID``          — this process's rank
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["initialize_distributed", "is_multi_host", "process_index", "process_count"]
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host gang if configured; no-op on a single host.
+
+    Returns True if distributed mode is active.  Safe to call repeatedly.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("PIO_PROCESS_ID", "0"))
+    )
+    logger.info(
+        "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

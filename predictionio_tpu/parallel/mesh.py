@@ -1,0 +1,110 @@
+"""Mesh construction and sharding-spec helpers.
+
+The standard mesh axes of the framework (SURVEY.md §2.4):
+
+- ``data``     — batch/data parallelism (the reference's RDD partitions)
+- ``model``    — tensor/factor-block parallelism (the reference's ALS
+  user×item blocking, MLlib-internal)
+- ``sequence`` — sequence/context parallelism (absent in the reference;
+  reserved so long-context engines can shard tokens without redesign)
+- ``expert``   — embedding-table / expert sharding (the EP-shaped axis the
+  DLRM engine uses for row-sharded tables + all_to_all)
+
+Tests run on a virtual CPU mesh (``XLA_FLAGS=--xla_force_host_platform_
+device_count=N`` — the moral equivalent of the reference's Spark
+``local[n]``, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "AXIS_SEQUENCE",
+    "AXIS_EXPERT",
+    "make_mesh",
+    "sharding",
+    "batch_sharding",
+    "replicated",
+    "cpu_devices_requested",
+]
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQUENCE = "sequence"
+AXIS_EXPERT = "expert"
+
+
+def cpu_devices_requested() -> int:
+    """How many virtual CPU devices XLA_FLAGS requests (test introspection)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    for tok in flags.split():
+        if tok.startswith("--xla_force_host_platform_device_count="):
+            return int(tok.split("=", 1)[1])
+    return 1
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh over the available devices.
+
+    ``axis_sizes`` maps axis name → size; at most one axis may be ``-1``
+    (absorbs remaining devices).  Default: all devices on the ``data`` axis.
+
+    The axis order given is the device-assignment order — on real TPU
+    hardware put the fastest-varying (innermost) axis on the most
+    bandwidth-hungry dimension so its collectives ride nearest-neighbor ICI
+    links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {AXIS_DATA: n}
+    sizes = dict(axis_sizes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError("At most one mesh axis may be -1.")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wild:
+        if n % fixed != 0:
+            raise ValueError(
+                f"Cannot infer axis {wild[0]!r}: {n} devices not divisible by {fixed}."
+            )
+        sizes[wild[0]] = n // fixed
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(
+            f"Mesh axes {sizes} need {total} devices but {n} are available."
+        )
+    mesh_devices = np.array(devices).reshape(*sizes.values())
+    return Mesh(mesh_devices, axis_names=tuple(sizes))
+
+
+def sharding(mesh: Mesh, *spec: Optional[str | Tuple[str, ...]]) -> NamedSharding:
+    """NamedSharding over ``mesh`` with one spec entry per array dim.
+
+    ``sharding(mesh, "data", None)`` shards dim 0 over ``data`` and
+    replicates dim 1.
+    """
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
+    """Shard the leading (batch) dim, replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (the reference's ``sc.broadcast`` analogue)."""
+    return NamedSharding(mesh, PartitionSpec())

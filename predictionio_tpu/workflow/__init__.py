@@ -1,0 +1,10 @@
+"""Workflow orchestration (reference: core/.../workflow/ — SURVEY.md §2.1)."""
+
+from predictionio_tpu.workflow.core_workflow import (
+    WorkflowError,
+    load_models,
+    run_evaluation,
+    run_train,
+)
+
+__all__ = ["WorkflowError", "load_models", "run_evaluation", "run_train"]

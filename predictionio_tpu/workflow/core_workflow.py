@@ -1,0 +1,254 @@
+"""CoreWorkflow — train/eval runs with engine-instance lifecycle.
+
+Reference: core/.../workflow/CoreWorkflow.scala (runTrain / runEvaluation)
+and CreateWorkflow.scala (the spark-submit main).  Call stack parity with
+SURVEY.md §3.1/§3.4:
+
+    run_train: bind params → EngineInstance(TRAINING) → Engine.train
+      → persist models → EngineInstance(COMPLETED | FAILED)
+    run_evaluation: sweep EngineParamsGenerator candidates → Engine.eval
+      → Metric.calculate → EvaluationInstance(EVALCOMPLETED)
+
+Model persistence (reference §5.4): models implementing
+:class:`~predictionio_tpu.controller.PersistentModel` save themselves (e.g.
+orbax sharded checkpoints); everything else is pickled into the MODELDATA
+blob store keyed by engine-instance id.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import pickle
+import traceback
+from typing import Any, List, Optional, Sequence, Tuple
+
+from predictionio_tpu.controller import (
+    Engine,
+    EngineParams,
+    EngineVariant,
+    Evaluation,
+    EngineParamsGenerator,
+    MetricEvaluatorResult,
+    PersistentModel,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import params_to_dict
+from predictionio_tpu.data.storage import (
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    Storage,
+)
+from predictionio_tpu.version import __version__
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["WorkflowError", "run_train", "load_models", "run_evaluation"]
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def _class_path(obj: Any) -> str:
+    cls = obj if isinstance(obj, type) else type(obj)
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def _engine_params_json(engine_params: EngineParams) -> dict:
+    return engine_params.to_json_dict()
+
+
+def run_train(
+    engine: Engine,
+    variant: EngineVariant,
+    ctx: Optional[RuntimeContext] = None,
+    *,
+    engine_id: Optional[str] = None,
+    engine_version: str = __version__,
+) -> str:
+    """Train an engine variant; returns the COMPLETED engine-instance id.
+
+    Reference: CoreWorkflow.runTrain — including the FAILED-status write on
+    error (§5.3 failure observation) which the caller relies on.
+    """
+    ctx = ctx or RuntimeContext.create()
+    storage: Storage = ctx.storage
+    engine_params = engine.bind_engine_params(variant.raw)
+    ep_json = _engine_params_json(engine_params)
+    instance = EngineInstance(
+        id=None,
+        status="TRAINING",
+        start_time=_now(),
+        end_time=None,
+        engine_id=engine_id or variant.engine_factory,
+        engine_version=engine_version,
+        engine_variant=variant.variant_id,
+        engine_factory=variant.engine_factory,
+        datasource_params=json.dumps(ep_json["datasource"]["params"]),
+        preparator_params=json.dumps(ep_json["preparator"]["params"]),
+        algorithms_params=json.dumps(ep_json["algorithms"]),
+        serving_params=json.dumps(ep_json["serving"]["params"]),
+    )
+    instances = storage.get_engine_instances()
+    instance_id = instances.insert(instance)
+    logger.info("EngineInstance %s TRAINING (factory=%s)", instance_id, variant.engine_factory)
+    try:
+        models = engine.train(ctx, engine_params)
+        _persist_models(models, instance_id, ctx)
+        instance.status = "COMPLETED"
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.info(
+            "EngineInstance %s COMPLETED in %.1fs",
+            instance_id,
+            (instance.end_time - instance.start_time).total_seconds(),
+        )
+        return instance_id
+    except Exception:
+        instance.status = "FAILED"
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.error("EngineInstance %s FAILED:\n%s", instance_id, traceback.format_exc())
+        raise
+
+
+def _persist_models(models: Sequence[Any], instance_id: str, ctx: RuntimeContext) -> None:
+    """One manifest blob per instance; each entry pickled or self-persisted."""
+    entries: List[dict] = []
+    payloads: List[Optional[bytes]] = []
+    for i, model in enumerate(models):
+        if isinstance(model, PersistentModel):
+            saved = model.save(f"{instance_id}.{i}", ctx)
+            if saved:
+                entries.append({"kind": "persistent", "class": _class_path(model)})
+                payloads.append(None)
+                continue
+        entries.append({"kind": "pickle", "class": _class_path(model)})
+        payloads.append(pickle.dumps(model, protocol=pickle.HIGHEST_PROTOCOL))
+    blob = pickle.dumps({"entries": entries, "payloads": payloads},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    ctx.storage.get_models().insert(Model(id=instance_id, models=blob))
+
+
+def load_models(
+    engine: Engine,
+    instance: EngineInstance,
+    ctx: Optional[RuntimeContext] = None,
+) -> List[Any]:
+    """Load the trained models of a COMPLETED instance (reference:
+    CreateServer model loading / PersistentModelLoader)."""
+    ctx = ctx or RuntimeContext.create()
+    blob = ctx.storage.get_models().get(instance.id)
+    if blob is None:
+        raise WorkflowError(f"No model data for engine instance {instance.id}.")
+    manifest = pickle.loads(blob.models)
+    engine_params = _bind_instance_params(engine, instance)
+    algo_params = dict(engine_params.algorithms_params)
+    models: List[Any] = []
+    for i, (entry, payload) in enumerate(zip(manifest["entries"], manifest["payloads"])):
+        if entry["kind"] == "pickle":
+            models.append(pickle.loads(payload))
+        else:
+            mod_name, _, qual = entry["class"].partition(":")
+            import importlib
+
+            cls = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                cls = getattr(cls, part)
+            name_i = list(algo_params)[i] if i < len(algo_params) else None
+            models.append(cls.load(f"{instance.id}.{i}", algo_params.get(name_i), ctx))
+    return models
+
+
+def _bind_instance_params(engine: Engine, instance: EngineInstance) -> EngineParams:
+    """Rebind the params snapshot stored on the instance row."""
+    variant_like = {
+        "datasource": {"params": json.loads(instance.datasource_params)},
+        "preparator": {"params": json.loads(instance.preparator_params)},
+        "algorithms": json.loads(instance.algorithms_params),
+        "serving": {"params": json.loads(instance.serving_params)},
+    }
+    return engine.bind_engine_params(variant_like)
+
+
+def instance_engine_params(engine: Engine, instance: EngineInstance) -> EngineParams:
+    """Public alias used by the serving layer."""
+    return _bind_instance_params(engine, instance)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    params_generator: EngineParamsGenerator,
+    ctx: Optional[RuntimeContext] = None,
+    *,
+    evaluation_class: str = "",
+    params_generator_class: str = "",
+) -> Tuple[str, MetricEvaluatorResult]:
+    """Sweep engine-params candidates and score them (reference:
+    CoreWorkflow.runEvaluation + MetricEvaluator.evaluateBase, §3.4)."""
+    ctx = ctx or RuntimeContext.create()
+    storage: Storage = ctx.storage
+    instance = EvaluationInstance(
+        id=None,
+        status="EVALRUNNING",
+        start_time=_now(),
+        end_time=None,
+        evaluation_class=evaluation_class or _class_path(evaluation.engine),
+        engine_params_generator_class=params_generator_class or _class_path(params_generator),
+    )
+    instances = storage.get_evaluation_instances()
+    instance_id = instances.insert(instance)
+    try:
+        engine = evaluation.engine
+        candidates = list(params_generator.engine_params_list)
+        if not candidates:
+            raise WorkflowError("EngineParamsGenerator produced no candidates.")
+        scored: List[Tuple[EngineParams, float, List[float]]] = []
+        for i, engine_params in enumerate(candidates):
+            eval_data = engine.eval(ctx, engine_params)
+            score = evaluation.metric.calculate(eval_data)
+            others = [m.calculate(eval_data) for m in evaluation.other_metrics]
+            scored.append((engine_params, score, others))
+            logger.info("eval candidate %d/%d: %s=%s", i + 1, len(candidates),
+                        evaluation.metric.header, score)
+        best_index = max(
+            range(len(scored)),
+            key=lambda i: (scored[i][1],),
+        )
+        result = MetricEvaluatorResult(
+            best_score=scored[best_index][1],
+            best_engine_params=scored[best_index][0],
+            best_index=best_index,
+            metric_header=evaluation.metric.header,
+            other_metric_headers=[m.header for m in evaluation.other_metrics],
+            candidate_scores=scored,
+        )
+        instance.status = "EVALCOMPLETED"
+        instance.end_time = _now()
+        instance.evaluator_results = result.summary()
+        instance.evaluator_results_json = json.dumps(
+            {
+                "bestScore": result.best_score,
+                "bestIndex": result.best_index,
+                "metric": result.metric_header,
+                "bestEngineParams": result.best_engine_params.to_json_dict(),
+                "candidates": [
+                    {"engineParams": p.to_json_dict(), "score": s, "others": o}
+                    for p, s, o in scored
+                ],
+            }
+        )
+        instances.update(instance)
+        return instance_id, result
+    except Exception:
+        instance.status = "EVALFAILED"
+        instance.end_time = _now()
+        instances.update(instance)
+        raise

@@ -7,13 +7,28 @@ no accelerator.  Must run before any ``import jax`` resolves a backend.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the deploy environment pre-sets JAX_PLATFORMS to the TPU
+# plugin AND initializes the backend from sitecustomize at interpreter start,
+# so setting env vars here is not enough — clear the initialized backends,
+# then re-select CPU.  Clear must come BEFORE the config update.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+try:  # private API — guard so a jax upgrade degrades to the env-var path
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        xla_bridge._clear_backends()
+except (ImportError, AttributeError):
+    pass
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

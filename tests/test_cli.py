@@ -1,0 +1,83 @@
+"""CLI verb tests (reference: tools Console verb dispatch, SURVEY.md §2.1)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.cli.main import main
+
+
+@pytest.fixture()
+def clean_storage(pio_home):
+    from predictionio_tpu.data.storage import reset_storage
+
+    reset_storage()
+    yield pio_home
+    reset_storage()
+
+
+def run(capsys, *argv):
+    code = main(list(argv))
+    out = capsys.readouterr().out
+    return code, out
+
+
+def test_app_lifecycle(clean_storage, capsys):
+    code, out = run(capsys, "app", "new", "myapp")
+    assert code == 0 and "Access Key:" in out
+    code, out = run(capsys, "app", "list")
+    assert "myapp" in out
+    code, out = run(capsys, "accesskey", "new", "myapp", "view", "buy")
+    assert code == 0 and "restricted" in out
+    code, out = run(capsys, "app", "channel-new", "myapp", "live")
+    assert code == 0
+    with pytest.raises(SystemExit):
+        run(capsys, "app", "channel-new", "myapp", "bad name!")
+    code, out = run(capsys, "app", "delete", "myapp", "-f")
+    assert code == 0
+
+
+def test_import_export_roundtrip(clean_storage, capsys, tmp_path):
+    run(capsys, "app", "new", "impapp")
+    src = tmp_path / "events.ndjson"
+    src.write_text(
+        "\n".join(
+            json.dumps(
+                {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+                 "targetEntityType": "item", "targetEntityId": "i1",
+                 "properties": {"rating": float(i)},
+                 "eventTime": f"2026-01-0{i+1}T00:00:00Z"}
+            )
+            for i in range(3)
+        )
+    )
+    code, out = run(capsys, "import", "--appid", "1", "--input", str(src))
+    assert code == 0 and "Imported 3 events" in out
+    dst = tmp_path / "out.ndjson"
+    code, out = run(capsys, "export", "--appid", "1", "--output", str(dst))
+    assert code == 0 and "Exported 3 events" in out
+    lines = [json.loads(l) for l in dst.read_text().splitlines()]
+    assert [l["entityId"] for l in lines] == ["u0", "u1", "u2"]
+    assert lines[0]["properties"]["rating"] == 0.0
+
+
+def test_train_via_cli(clean_storage, capsys, tmp_path):
+    variant = tmp_path / "engine.json"
+    variant.write_text(json.dumps({
+        "engineFactory": "tests.test_controller_workflow:fake_engine",
+        "datasource": {"params": {"n": 4}},
+        "algorithms": [{"name": "mul", "params": {"factor": 2}}],
+    }))
+    code, out = run(capsys, "train", "--engine-json", str(variant))
+    assert code == 0 and "Training completed" in out
+
+
+def test_status(clean_storage, capsys):
+    code, out = run(capsys, "status")
+    assert code == 0
+    assert "METADATA" in out and "sanity check OK" in out
+
+
+def test_bad_engine_json(clean_storage, capsys):
+    with pytest.raises(SystemExit):
+        run(capsys, "train", "--engine-json", "/nonexistent/engine.json")

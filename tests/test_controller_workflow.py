@@ -1,0 +1,299 @@
+"""DASE controller + workflow tests with fake engines.
+
+Reference: core/src/test/scala fake-DASE suites ("FakeWorkflow",
+EngineTest, JsonExtractorSuite — SURVEY.md §4 "engine-workflow fakes").
+"""
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    EmptyParams,
+    Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    EngineVariant,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    ParamsBindingError,
+    PersistentModel,
+    Preparator,
+    RuntimeContext,
+    Serving,
+    bind_params,
+    load_engine_factory,
+)
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.workflow import load_models, run_evaluation, run_train
+
+
+# -- params binding ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    rank: int
+    reg: float = 0.1
+    name: str = "als"
+    seeds: Tuple[int, ...] = (1, 2)
+    nested: Optional["SubParams"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubParams(Params):
+    depth: int = 1
+
+
+class TestParamsBinding:
+    def test_basic_and_defaults(self):
+        p = bind_params(AlgoParams, {"rank": 8})
+        assert p.rank == 8 and p.reg == 0.1 and p.seeds == (1, 2)
+
+    def test_float_accepts_int(self):
+        assert bind_params(AlgoParams, {"rank": 8, "reg": 1}).reg == 1.0
+
+    def test_strict_unknown_keys(self):
+        with pytest.raises(ParamsBindingError, match="unknown keys"):
+            bind_params(AlgoParams, {"rank": 8, "typo": 1})
+
+    def test_missing_required(self):
+        with pytest.raises(ParamsBindingError, match="required"):
+            bind_params(AlgoParams, {})
+
+    def test_type_mismatch(self):
+        with pytest.raises(ParamsBindingError):
+            bind_params(AlgoParams, {"rank": "eight"})
+        with pytest.raises(ParamsBindingError):
+            bind_params(AlgoParams, {"rank": True})
+
+    def test_nested_and_optional(self):
+        p = bind_params(AlgoParams, {"rank": 1, "nested": {"depth": 3}})
+        assert p.nested == SubParams(depth=3)
+        assert bind_params(AlgoParams, {"rank": 1, "nested": None}).nested is None
+
+    def test_tuple_coercion(self):
+        assert bind_params(AlgoParams, {"rank": 1, "seeds": [5, 6]}).seeds == (5, 6)
+
+
+# -- fake DASE engine -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FakeDSParams(Params):
+    n: int = 10
+
+
+class FakeDataSource(DataSource):
+    params_class = FakeDSParams
+
+    def read_training(self, ctx):
+        return list(range(self.params.n))
+
+    def read_eval(self, ctx):
+        # two folds; queries are ints, actual = query * 2
+        folds = []
+        for fold in range(2):
+            td = list(range(self.params.n))
+            qa = [(q, q * 2) for q in range(3)]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class DoublePreparator(Preparator):
+    def prepare(self, ctx, td):
+        return [x * 2 for x in td]
+
+
+@dataclasses.dataclass(frozen=True)
+class MulParams(Params):
+    factor: int = 1
+
+
+class MulAlgorithm(Algorithm):
+    """model = factor * sum(pd); predict(q) = model_factor * q."""
+
+    params_class = MulParams
+
+    def train(self, ctx, pd):
+        return {"factor": self.params.factor, "total": sum(pd)}
+
+    def predict(self, model, query):
+        return model["factor"] * query
+
+
+def fake_engine() -> Engine:
+    return Engine(
+        datasource_class=FakeDataSource,
+        preparator_class=DoublePreparator,
+        algorithm_classes={"mul": MulAlgorithm},
+        serving_class=FirstServing,
+    )
+
+
+VARIANT = {
+    "engineFactory": "tests.test_controller_workflow:fake_engine",
+    "id": "test-variant",
+    "datasource": {"params": {"n": 4}},
+    "algorithms": [{"name": "mul", "params": {"factor": 3}}],
+}
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    from predictionio_tpu.data.storage import reset_storage
+
+    reset_storage()
+    yield RuntimeContext.create()
+    reset_storage()
+
+
+class TestEngine:
+    def test_bind_engine_params(self):
+        e = fake_engine()
+        ep = e.bind_engine_params(VARIANT)
+        assert ep.datasource_params == FakeDSParams(n=4)
+        assert ep.algorithms_params == (("mul", MulParams(factor=3)),)
+
+    def test_unknown_algorithm(self):
+        e = fake_engine()
+        with pytest.raises(ParamsBindingError, match="Unknown algorithm"):
+            e.bind_engine_params({**VARIANT, "algorithms": [{"name": "nope"}]})
+
+    def test_train(self, ctx):
+        e = fake_engine()
+        models = e.train(ctx, e.bind_engine_params(VARIANT))
+        # td = [0..3], prepared doubles → sum=12
+        assert models == [{"factor": 3, "total": 12}]
+
+    def test_eval(self, ctx):
+        e = fake_engine()
+        folds = e.eval(ctx, e.bind_engine_params(VARIANT))
+        assert len(folds) == 2
+        info, qpa = folds[0]
+        assert info == {"fold": 0}
+        assert qpa == [(0, 0, 0), (1, 3, 2), (2, 6, 4)]
+
+    def test_load_engine_factory(self):
+        f = load_engine_factory("tests.test_controller_workflow:fake_engine")
+        assert isinstance(f(), Engine)
+        f2 = load_engine_factory("tests.test_controller_workflow.fake_engine")
+        assert isinstance(f2(), Engine)
+        with pytest.raises(ParamsBindingError):
+            load_engine_factory("tests.test_controller_workflow:nope")
+        with pytest.raises(ParamsBindingError):
+            load_engine_factory("no.such.module:f")
+
+
+class TestRunTrain:
+    def test_lifecycle_and_model_roundtrip(self, ctx):
+        e = fake_engine()
+        variant = EngineVariant.from_dict(VARIANT)
+        iid = run_train(e, variant, ctx)
+        inst = ctx.storage.get_engine_instances().get(iid)
+        assert inst.status == "COMPLETED"
+        assert inst.end_time is not None
+        assert inst.engine_variant == "test-variant"
+        assert json.loads(inst.algorithms_params) == [
+            {"name": "mul", "params": {"factor": 3}}
+        ]
+        # latest-completed resolution, like pio deploy does
+        latest = ctx.storage.get_engine_instances().get_latest_completed(
+            inst.engine_id, inst.engine_version, inst.engine_variant
+        )
+        assert latest.id == iid
+        models = load_models(e, inst, ctx)
+        assert models == [{"factor": 3, "total": 12}]
+
+    def test_failure_marks_instance(self, ctx):
+        class BoomAlgorithm(MulAlgorithm):
+            def train(self, ctx, pd):
+                raise RuntimeError("boom")
+
+        e = Engine(FakeDataSource, DoublePreparator, {"mul": BoomAlgorithm})
+        variant = EngineVariant.from_dict(VARIANT)
+        with pytest.raises(RuntimeError, match="boom"):
+            run_train(e, variant, ctx)
+        all_inst = ctx.storage.get_engine_instances().get_all()
+        assert len(all_inst) == 1 and all_inst[0].status == "FAILED"
+
+
+class SquaredError(AverageMetric):
+    def calculate_one(self, q, p, a):
+        return -float((p - a) ** 2)  # higher is better
+
+
+class SweepGenerator(EngineParamsGenerator):
+    @property
+    def engine_params_list(self):
+        e = fake_engine()
+        out = []
+        for factor in (1, 2, 3):
+            out.append(
+                e.bind_engine_params(
+                    {**VARIANT, "algorithms": [{"name": "mul", "params": {"factor": factor}}]}
+                )
+            )
+        return out
+
+
+class TestRunEvaluation:
+    def test_sweep_picks_best(self, ctx):
+        # actual = 2*q, predict = factor*q → factor=2 is optimal
+        e = fake_engine()
+        evaluation = Evaluation(engine=e, metric=SquaredError())
+        iid, result = run_evaluation(evaluation, SweepGenerator(), ctx)
+        assert result.best_index == 1
+        assert result.best_score == 0.0
+        best_algo = dict(result.best_engine_params.algorithms_params)
+        assert best_algo["mul"] == MulParams(factor=2)
+        inst = ctx.storage.get_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        parsed = json.loads(inst.evaluator_results_json)
+        assert parsed["bestIndex"] == 1
+        assert len(parsed["candidates"]) == 3
+        assert ctx.storage.get_evaluation_instances().get_completed()[0].id == iid
+
+
+class SelfSavingModel(PersistentModel):
+    """Exercises the PersistentModel path end-to-end."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def save(self, instance_id, ctx):
+        ctx.storage.get_models().insert(
+            __import__("predictionio_tpu.data.storage", fromlist=["Model"]).Model(
+                id=f"custom-{instance_id}", models=str(self.value).encode()
+            )
+        )
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params, ctx):
+        blob = ctx.storage.get_models().get(f"custom-{instance_id}")
+        return cls(int(blob.models.decode()))
+
+
+class PersistentAlgorithm(MulAlgorithm):
+    def train(self, ctx, pd):
+        return SelfSavingModel(sum(pd))
+
+    def predict(self, model, query):
+        return model.value
+
+
+class TestPersistentModel:
+    def test_custom_persistence_roundtrip(self, ctx):
+        e = Engine(FakeDataSource, DoublePreparator, {"mul": PersistentAlgorithm})
+        variant = EngineVariant.from_dict(VARIANT)
+        iid = run_train(e, variant, ctx)
+        inst = ctx.storage.get_engine_instances().get(iid)
+        models = load_models(e, inst, ctx)
+        assert isinstance(models[0], SelfSavingModel)
+        assert models[0].value == 12

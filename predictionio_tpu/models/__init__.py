@@ -1,0 +1,14 @@
+"""Model library — TPU-native algorithms backing the engine templates.
+
+Each module is a pure numeric component (numpy/COO in, jax pytrees out);
+the DASE templates in :mod:`predictionio_tpu.templates` wrap these with
+event reading, id indexing, and serving logic.
+
+- :mod:`als`       — blocked explicit/implicit ALS (reference: Spark MLlib
+  ``ALS.train``/``trainImplicit`` behind the recommendation template)
+- :mod:`linear`    — logistic regression / softmax classifier (reference:
+  MLlib LogisticRegression/NaiveBayes behind the classification template)
+- :mod:`naive_bayes` — multinomial naive Bayes (one-pass psum counts)
+- :mod:`two_tower` — neural retrieval, DP over the mesh (BASELINE config 4)
+- :mod:`dlrm`      — CTR ranking with row-sharded embeddings (config 5)
+"""

@@ -1,0 +1,224 @@
+"""Alternating least squares, TPU-shaped.
+
+Reference behavior: Spark MLlib ``ALS.train`` / ``ALS.trainImplicit`` as
+invoked by the recommendation template (SURVEY.md §2.2, §3.1 hot loop).
+MLlib's implementation is shuffle-shaped: user×item factor blocks exchanged
+between executors, per-block normal equations solved via JNI BLAS.
+
+The TPU design replaces all of that with one batched XLA program per side
+per iteration (SURVEY.md §7 step 5):
+
+- ragged ratings → degree-bucketed padded blocks (host-side, once)
+- per-entity normal equations built by batched einsum over gathered
+  factors (MXU) — ``A_u = Σ_i w_ui · y_i y_iᵀ``
+- batched Cholesky solves (``ops.linalg.batched_ridge_solve``)
+- factor "exchange" = nothing within a chip, an all-gather across the mesh
+  (factors replicated; solve rows sharded on the ``data`` axis)
+
+Regularization follows MLlib's ALS-WR scaling: λ·n_u per user (n_u = that
+user's rating count), λ·n_i per item.  Implicit feedback follows
+Hu-Koren-Volinsky: confidence c = 1 + α·r, preference p = 1(r>0), with the
+``YᵀY`` term shared across users.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.linalg import gram, masked_gram
+from predictionio_tpu.ops.ragged import Padded, bucket_by_length
+from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
+from predictionio_tpu.parallel.mesh import AXIS_DATA
+
+__all__ = ["ALSConfig", "ALSModel", "train_als", "recommend", "predict_scores"]
+
+
+@dataclasses.dataclass
+class ALSConfig:
+    rank: int = 32
+    iterations: int = 10
+    reg: float = 0.01          # MLlib regParam (λ), ALS-WR scaled by degree
+    alpha: float = 1.0         # implicit confidence scale
+    implicit: bool = False
+    max_degree: Optional[int] = None   # truncate overlong entities (None = exact)
+    bucket_bounds: Sequence[int] = (16, 64, 256, 1024)
+    seed: int = 42
+    dtype: str = "float32"     # factor storage dtype; solves always f32
+
+
+@dataclasses.dataclass
+class ALSModel:
+    """Trained factors. ``user_factors [U,K]``, ``item_factors [I,K]``."""
+
+    user_factors: jax.Array
+    item_factors: jax.Array
+    rank: int
+    implicit: bool
+
+    def tree_flatten(self):  # manual pytree-ish helpers for checkpointing
+        return {"user_factors": self.user_factors, "item_factors": self.item_factors}
+
+
+def _solve_bucket(
+    indices: jax.Array,    # [R, L] int32 — other-side ids
+    values: jax.Array,     # [R, L] f32
+    mask: jax.Array,       # [R, L] bool
+    factors: jax.Array,    # [N, K] other-side factors
+    yty: jax.Array,        # [K, K] — YᵀY (zeros when explicit)
+    reg: jax.Array,        # scalar λ
+    alpha: jax.Array,      # scalar α
+    implicit: bool,
+) -> jax.Array:
+    """One padded block of normal equations + Cholesky solves → [R, K]."""
+    f = factors[indices]                      # [R, L, K] gather
+    m = mask.astype(jnp.float32)
+    if implicit:
+        # Hu-Koren-Volinsky per MLlib: c = 1 + α·|r|, p = 1(r>0).
+        # A = YᵀY + Σ (c-1)·y yᵀ,  b = Σ c·p·y — (c-1) ≥ 0 keeps A PSD.
+        w = alpha * jnp.abs(values) * m       # c - 1
+        p = (values > 0).astype(jnp.float32) * m
+        a = yty[None, :, :] + masked_gram(f, w)
+        b = jnp.einsum("blk,bl->bk", f, (1.0 + w) * p,
+                       preferred_element_type=jnp.float32)
+    else:
+        a = masked_gram(f, m)
+        b = jnp.einsum("blk,bl->bk", f, values * m,
+                       preferred_element_type=jnp.float32)
+    degree = jnp.maximum(m.sum(axis=1), 1.0)  # ALS-WR: λ·n_u
+    return _ridge(a, b, reg * degree)
+
+
+def _ridge(a: jax.Array, b: jax.Array, reg_vec: jax.Array) -> jax.Array:
+    k = a.shape[-1]
+    eye = jnp.eye(k, dtype=a.dtype)
+    a_reg = a + reg_vec[:, None, None] * eye
+    chol = jnp.linalg.cholesky(a_reg)
+    y = jax.scipy.linalg.solve_triangular(chol, b[..., None], lower=True)
+    x = jax.scipy.linalg.solve_triangular(chol, y, lower=True, trans="T")
+    return x[..., 0]
+
+
+def _scatter_rows(dst: jax.Array, row_ids: jax.Array, rows: jax.Array) -> jax.Array:
+    """Write solved rows back; row_id == -1 rows (bucket padding) dropped.
+
+    Invalid rows are routed out-of-bounds so ``mode="drop"`` discards them —
+    never clamp them to a real index (a clamped duplicate write races the
+    genuine row-0 update).
+    """
+    safe = jnp.where(row_ids >= 0, row_ids, dst.shape[0])
+    return dst.at[safe].set(rows, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("implicit",))
+def _side_step(
+    indices, values, mask, row_ids, dst_factors, src_factors, reg, alpha, *, implicit
+):
+    yty = gram(src_factors) if implicit else jnp.zeros(
+        (src_factors.shape[1], src_factors.shape[1]), jnp.float32)
+    solved = _solve_bucket(indices, values, mask, src_factors, yty, reg, alpha, implicit)
+    return _scatter_rows(dst_factors, row_ids, solved)
+
+
+def _device_buckets(buckets: List[Padded], mesh: Optional[Mesh]) -> List[Tuple]:
+    out = []
+    for p in buckets:
+        arrs = (
+            jnp.asarray(p.indices), jnp.asarray(p.values),
+            jnp.asarray(p.mask), jnp.asarray(p.row_ids),
+        )
+        if mesh is not None:
+            row = NamedSharding(mesh, P(AXIS_DATA))
+            arrs = tuple(
+                jax.device_put(a, row if a.ndim >= 1 else None) for a in arrs
+            )
+        out.append(arrs)
+    return out
+
+
+def train_als(
+    user_ids: np.ndarray,
+    item_ids: np.ndarray,
+    ratings: Optional[np.ndarray],
+    n_users: int,
+    n_items: int,
+    config: ALSConfig,
+    mesh: Optional[Mesh] = None,
+) -> ALSModel:
+    """Train from COO triplets.
+
+    With a mesh, solve rows are sharded over the ``data`` axis and factors
+    are replicated — the per-iteration factor exchange is the implicit
+    all-gather XLA inserts, riding ICI (reference: Spark shuffle between
+    in/out ALS blocks).
+    """
+    rng = np.random.default_rng(config.seed)
+    k = config.rank
+    pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
+    # Deterministic scaled-normal init (MLlib uses Xavier-ish normal / sqrt(k)).
+    uf = jnp.asarray(rng.standard_normal((n_users, k), dtype=np.float32) / np.sqrt(k))
+    itf = jnp.asarray(rng.standard_normal((n_items, k), dtype=np.float32) / np.sqrt(k))
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        uf = jax.device_put(uf, rep)
+        itf = jax.device_put(itf, rep)
+
+    user_buckets = _device_buckets(
+        bucket_by_length(user_ids, item_ids, ratings, n_users,
+                         bucket_bounds=config.bucket_bounds,
+                         max_len=config.max_degree, pad_rows_to=pad_rows),
+        mesh,
+    )
+    item_buckets = _device_buckets(
+        bucket_by_length(item_ids, user_ids, ratings, n_items,
+                         bucket_bounds=config.bucket_bounds,
+                         max_len=config.max_degree, pad_rows_to=pad_rows),
+        mesh,
+    )
+    reg = jnp.float32(config.reg)
+    alpha = jnp.float32(config.alpha)
+    for _ in range(config.iterations):
+        for idx, vals, msk, rid in user_buckets:
+            uf = _side_step(idx, vals, msk, rid, uf, itf, reg, alpha,
+                            implicit=config.implicit)
+        for idx, vals, msk, rid in item_buckets:
+            itf = _side_step(idx, vals, msk, rid, itf, uf, reg, alpha,
+                             implicit=config.implicit)
+    return ALSModel(user_factors=uf, item_factors=itf, rank=k,
+                    implicit=config.implicit)
+
+
+@jax.jit
+def predict_scores(user_factors: jax.Array, item_factors: jax.Array,
+                   users: jax.Array, items: jax.Array) -> jax.Array:
+    """Pointwise r̂_ui for parallel (user, item) id vectors."""
+    return jnp.einsum("bk,bk->b", user_factors[users], item_factors[items],
+                      preferred_element_type=jnp.float32)
+
+
+def recommend(
+    model: ALSModel,
+    user_indices: jax.Array,          # [B] int
+    k: int,
+    *,
+    seen: Optional[jax.Array] = None,  # [B, n_items] bool — exclude
+    chunk: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k items per user (reference: MLlib recommendProducts)."""
+    q = model.user_factors[user_indices]
+    if chunk:
+        return chunked_top_k(q, model.item_factors, k, chunk=chunk)
+    return top_k_scores(q, model.item_factors, k, exclude=seen)
+
+
+def rmse(model: ALSModel, user_ids, item_ids, ratings) -> float:
+    """Explicit-feedback fit metric (host-side convenience)."""
+    pred = predict_scores(model.user_factors, model.item_factors,
+                          jnp.asarray(user_ids), jnp.asarray(item_ids))
+    return float(jnp.sqrt(jnp.mean((pred - jnp.asarray(ratings)) ** 2)))

@@ -1,0 +1,25 @@
+"""TPU-numeric building blocks shared by the model layer.
+
+These are the rebuild's replacement for the reference's reliance on Spark
+MLlib + netlib BLAS (SURVEY.md §2.3): dense, batched, statically-shaped
+primitives that XLA tiles onto the MXU.
+
+- :mod:`ragged`  — ragged event streams → fixed-shape padded blocks
+  (the recompilation-discipline layer, SURVEY.md §7 hard parts)
+- :mod:`linalg`  — batched ridge/Cholesky solves (ALS normal equations)
+- :mod:`topk`    — chunked dot-product top-K retrieval (serving hot path)
+"""
+
+from predictionio_tpu.ops.linalg import batched_ridge_solve, gram
+from predictionio_tpu.ops.ragged import Padded, pad_ragged, bucket_by_length
+from predictionio_tpu.ops.topk import top_k_scores, chunked_top_k
+
+__all__ = [
+    "batched_ridge_solve",
+    "gram",
+    "Padded",
+    "pad_ragged",
+    "bucket_by_length",
+    "top_k_scores",
+    "chunked_top_k",
+]

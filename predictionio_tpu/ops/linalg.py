@@ -1,0 +1,48 @@
+"""Batched dense linear algebra for the MXU.
+
+The reference's ALS leans on netlib/OpenBLAS JNI for per-block normal
+equations (SURVEY.md §2.3).  Here the same math is a single batched XLA
+program: Gram matrices via einsum (MXU) and positive-definite solves via
+batched Cholesky.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["gram", "batched_ridge_solve", "masked_gram"]
+
+
+def gram(y: jax.Array) -> jax.Array:
+    """``YᵀY`` for ``[n, k]`` factors — one MXU matmul, f32 accumulation."""
+    return jnp.einsum("nk,nl->kl", y, y, preferred_element_type=jnp.float32)
+
+
+def masked_gram(f: jax.Array, w: jax.Array) -> jax.Array:
+    """Weighted per-row Gram: ``[B, L, K] × [B, L] → [B, K, K]``.
+
+    Computes ``Σ_l w[b,l] · f[b,l,:] ⊗ f[b,l,:]`` — the per-entity portion of
+    the ALS normal-equation matrix.  Batched einsum → MXU-tiled by XLA.
+    """
+    return jnp.einsum(
+        "blk,bl,blm->bkm", f, w, f, preferred_element_type=jnp.float32
+    )
+
+
+def batched_ridge_solve(a: jax.Array, b: jax.Array, reg: jax.Array | float) -> jax.Array:
+    """Solve ``(A + reg·I) x = b`` for a batch of PSD ``A``: ``[B,K,K],[B,K]``.
+
+    Uses Cholesky — A is PSD by construction in ALS (Gram + λI).  Falls back
+    on well-posedness from the ridge term; callers guarantee ``reg > 0``.
+    """
+    k = a.shape[-1]
+    eye = jnp.eye(k, dtype=a.dtype)
+    a_reg = a + reg * eye
+    chol = jnp.linalg.cholesky(a_reg)
+    # Two triangular solves; batched over leading dims.
+    y = jax.scipy.linalg.solve_triangular(chol, b[..., None], lower=True)
+    x = jax.scipy.linalg.solve_triangular(
+        chol, y, lower=True, trans="T"
+    )
+    return x[..., 0]

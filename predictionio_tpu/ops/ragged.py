@@ -1,0 +1,153 @@
+"""Ragged → dense layout transforms.
+
+The reference's data plane is ragged by construction (RDD of per-user rating
+lists; Spark shuffles them between ALS blocks).  XLA wants static shapes, so
+every ragged stream is converted host-side into padded ``[rows, L]`` index /
+value blocks with a validity mask, optionally bucketed by row length so that
+short rows don't pay the max-degree padding cost (SURVEY.md §7 "hard parts":
+the ragged→dense gather layout).
+
+All functions here are host-side numpy (they run once per training run,
+before device_put); the outputs are what gets sharded onto the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Padded", "pad_ragged", "bucket_by_length", "segment_counts"]
+
+
+@dataclasses.dataclass
+class Padded:
+    """A padded ragged batch.
+
+    - ``indices``: int32 ``[rows, L]`` — column ids, 0 where padded
+    - ``values``:  float32 ``[rows, L]`` — entry values, 0 where padded
+    - ``mask``:    bool ``[rows, L]`` — True on real entries
+    - ``row_ids``: int32 ``[rows]`` — original row id of each padded row
+    """
+
+    indices: np.ndarray
+    values: np.ndarray
+    mask: np.ndarray
+    row_ids: np.ndarray
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.indices.shape  # type: ignore[return-value]
+
+
+def segment_counts(rows: np.ndarray, n_rows: int) -> np.ndarray:
+    """Entries per row (rows need not be sorted)."""
+    return np.bincount(rows, minlength=n_rows).astype(np.int32)
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pad_ragged(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: Optional[np.ndarray],
+    n_rows: int,
+    *,
+    max_len: Optional[int] = None,
+    pad_rows_to: int = 1,
+) -> Padded:
+    """COO triplets → one padded block ``[n_rows_padded, L]``.
+
+    ``L`` = max row length (or ``max_len`` cap — rows beyond it are truncated,
+    keeping the *latest* entries, matching the reference's LEventStore
+    ``reversed=true, limit=N`` semantics for "recent interactions").
+    ``pad_rows_to`` rounds the row count up (mesh divisibility).
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if vals is None:
+        vals = np.ones(len(rows), dtype=np.float32)
+    vals = np.asarray(vals, dtype=np.float32)
+    counts = segment_counts(rows, n_rows)
+    natural = int(counts.max()) if len(counts) and counts.max() > 0 else 1
+    L = min(natural, max_len) if max_len else natural
+    L = max(L, 1)
+    R = _round_up(max(n_rows, 1), pad_rows_to)
+
+    # Stable sort by row so each row's entries are contiguous, preserving
+    # insertion (event-time) order within a row.
+    order = np.argsort(rows, kind="stable")
+    r_sorted, c_sorted, v_sorted = rows[order], cols[order], vals[order]
+    # Position of each entry within its row.
+    starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(len(r_sorted)) - starts[r_sorted]
+    # Truncate: keep the LAST L entries of overlong rows.
+    keep = pos >= (counts[r_sorted] - L)
+    r_k, c_k, v_k = r_sorted[keep], c_sorted[keep], v_sorted[keep]
+    pos_k = pos[keep] - np.maximum(counts[r_k] - L, 0)
+
+    indices = np.zeros((R, L), dtype=np.int32)
+    values = np.zeros((R, L), dtype=np.float32)
+    mask = np.zeros((R, L), dtype=bool)
+    indices[r_k, pos_k] = c_k
+    values[r_k, pos_k] = v_k
+    mask[r_k, pos_k] = True
+    return Padded(indices=indices, values=values, mask=mask,
+                  row_ids=np.arange(R, dtype=np.int32))
+
+
+def bucket_by_length(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: Optional[np.ndarray],
+    n_rows: int,
+    *,
+    bucket_bounds: Sequence[int] = (16, 64, 256, 1024),
+    max_len: Optional[int] = None,
+    pad_rows_to: int = 1,
+) -> List[Padded]:
+    """COO triplets → per-length-bucket padded blocks.
+
+    Rows are grouped by degree into buckets with padded length equal to the
+    bucket bound, so a 3-item user costs 16 slots, not max-degree slots.
+    This is the TPU answer to Spark ALS's ragged shuffle blocks: a handful
+    of static shapes (one compile each) instead of one worst-case shape.
+    Returns blocks ordered short→long; ``row_ids`` maps back to real rows.
+    """
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    if vals is None:
+        vals = np.ones(len(rows), dtype=np.float32)
+    vals = np.asarray(vals, dtype=np.float32)
+    counts = segment_counts(rows, n_rows)
+    cap = max_len or (int(counts.max()) if len(counts) else 1)
+    bounds = sorted(set(min(b, cap) for b in bucket_bounds if b > 0))
+    if not bounds or bounds[-1] < cap:
+        bounds.append(cap)
+
+    out: List[Padded] = []
+    all_rows = np.arange(n_rows, dtype=np.int64)
+    prev = 0
+    for b in bounds:
+        sel = all_rows[(counts > prev) & (counts <= b)] if prev else \
+            all_rows[counts <= b]
+        prev = b
+        if len(sel) == 0:
+            continue
+        # Remap selected rows to 0..len(sel)-1, pad within the bucket.
+        remap = np.full(n_rows, -1, dtype=np.int64)
+        remap[sel] = np.arange(len(sel))
+        in_bucket = remap[rows] >= 0
+        p = pad_ragged(
+            remap[rows[in_bucket]], cols[in_bucket], vals[in_bucket],
+            len(sel), max_len=b, pad_rows_to=pad_rows_to,
+        )
+        real = np.full(p.indices.shape[0], -1, dtype=np.int32)
+        real[: len(sel)] = sel.astype(np.int32)
+        p.row_ids = real
+        out.append(p)
+    return out

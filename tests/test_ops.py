@@ -1,0 +1,118 @@
+"""ops layer: ragged padding, batched solves, top-k (vs numpy oracles)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from predictionio_tpu.ops import (
+    Padded,
+    batched_ridge_solve,
+    bucket_by_length,
+    chunked_top_k,
+    gram,
+    pad_ragged,
+    top_k_scores,
+)
+from predictionio_tpu.ops.topk import sharded_top_k
+from predictionio_tpu.parallel.mesh import make_mesh
+
+
+class TestPadRagged:
+    def test_roundtrip(self):
+        rows = np.array([0, 0, 2, 2, 2, 1])
+        cols = np.array([5, 7, 1, 2, 3, 9])
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], dtype=np.float32)
+        p = pad_ragged(rows, cols, vals, n_rows=3)
+        assert p.shape == (3, 3)
+        assert p.mask.sum() == 6
+        # Row 0: two entries in insertion order.
+        assert list(p.indices[0][p.mask[0]]) == [5, 7]
+        assert list(p.values[2][p.mask[2]]) == [3.0, 4.0, 5.0]
+
+    def test_truncation_keeps_latest(self):
+        rows = np.zeros(5, dtype=np.int64)
+        cols = np.arange(5)
+        p = pad_ragged(rows, cols, None, n_rows=1, max_len=3)
+        assert list(p.indices[0]) == [2, 3, 4]
+
+    def test_empty_rows_and_row_padding(self):
+        p = pad_ragged(np.array([1]), np.array([0]), None, n_rows=3, pad_rows_to=4)
+        assert p.indices.shape[0] == 4
+        assert p.mask.sum() == 1
+
+    def test_bucketing_partitions_rows(self):
+        rng = np.random.default_rng(0)
+        n_rows = 50
+        lens = rng.integers(0, 40, n_rows)
+        rows = np.repeat(np.arange(n_rows), lens)
+        cols = rng.integers(0, 100, rows.shape[0])
+        vals = rng.standard_normal(rows.shape[0]).astype(np.float32)
+        buckets = bucket_by_length(rows, cols, vals, n_rows, bucket_bounds=(4, 16))
+        seen = np.concatenate([b.row_ids[b.row_ids >= 0] for b in buckets])
+        assert sorted(seen.tolist()) == list(range(n_rows))
+        total = sum(int(b.mask.sum()) for b in buckets)
+        assert total == rows.shape[0]
+        for b in buckets:  # every real row's entries survive bucketing
+            for r_local, r_global in enumerate(b.row_ids):
+                if r_global < 0:
+                    continue
+                expect = set(cols[rows == r_global].tolist())
+                got = set(b.indices[r_local][b.mask[r_local]].tolist())
+                assert got == expect
+
+
+class TestLinalg:
+    def test_ridge_solve_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        m = rng.standard_normal((4, 6, 3)).astype(np.float32)
+        a = np.einsum("blk,blm->bkm", m, m)
+        b = rng.standard_normal((4, 3)).astype(np.float32)
+        x = batched_ridge_solve(jnp.asarray(a), jnp.asarray(b), 0.1)
+        for i in range(4):
+            expect = np.linalg.solve(a[i] + 0.1 * np.eye(3), b[i])
+            np.testing.assert_allclose(np.asarray(x[i]), expect, rtol=1e-4, atol=1e-4)
+
+    def test_gram(self):
+        y = np.arange(12, dtype=np.float32).reshape(4, 3)
+        np.testing.assert_allclose(np.asarray(gram(jnp.asarray(y))), y.T @ y, rtol=1e-6)
+
+
+class TestTopK:
+    def _setup(self):
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((3, 8)).astype(np.float32)
+        items = rng.standard_normal((64, 8)).astype(np.float32)
+        return q, items
+
+    def test_matches_numpy(self):
+        q, items = self._setup()
+        s, i = top_k_scores(jnp.asarray(q), jnp.asarray(items), 5)
+        scores = q @ items.T
+        expect = np.argsort(-scores, axis=1)[:, :5]
+        np.testing.assert_array_equal(np.asarray(i), expect)
+
+    def test_exclusion(self):
+        q, items = self._setup()
+        scores = q @ items.T
+        top1 = np.argmax(scores, axis=1)
+        excl = np.zeros((3, 64), dtype=bool)
+        excl[np.arange(3), top1] = True
+        _, i = top_k_scores(jnp.asarray(q), jnp.asarray(items), 5,
+                            exclude=jnp.asarray(excl))
+        assert not any(top1[b] in np.asarray(i[b]) for b in range(3))
+
+    def test_chunked_matches_dense(self):
+        q, items = self._setup()
+        s1, i1 = top_k_scores(jnp.asarray(q), jnp.asarray(items), 7)
+        s2, i2 = chunked_top_k(jnp.asarray(q), jnp.asarray(items), 7, chunk=16)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_sharded_matches_dense(self):
+        q, items = self._setup()
+        mesh = make_mesh({"data": 8})
+        s1, i1 = top_k_scores(jnp.asarray(q), jnp.asarray(items), 5)
+        s2, i2 = sharded_top_k(mesh, "data", jnp.asarray(q), jnp.asarray(items), 5)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

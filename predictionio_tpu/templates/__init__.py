@@ -1,0 +1,15 @@
+"""Official engine templates, rebuilt TPU-native.
+
+Reference: the in-repo template mirrors under ``examples/scala-parallel-*``
+(SURVEY.md §2.2) — these are the capability bar.  Each template package
+exposes an ``engine()`` factory (the reference's EngineFactory), typed
+Params per DASE role, and preserves the template's query/result JSON shape
+so existing clients work unchanged.
+
+- :mod:`recommendation`  — ALS personal recommendations (MLlib ALS parity)
+- :mod:`classification`  — logreg / naive Bayes attribute classification
+- :mod:`similarproduct`  — similar-item retrieval from ALS item factors
+- :mod:`ecommerce`       — ALS + business-rule filtering in Serving
+- :mod:`twotower`        — neural two-tower retrieval (TPU-era addition)
+- :mod:`dlrm`            — CTR ranking with sharded embeddings (TPU-era)
+"""

@@ -1,0 +1,27 @@
+from predictionio_tpu.templates.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModelWrapper,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    RecommendationDataSource,
+    RecommendationPreparator,
+    Ratings,
+    engine,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModelWrapper",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "RecommendationDataSource",
+    "RecommendationPreparator",
+    "Ratings",
+    "engine",
+]

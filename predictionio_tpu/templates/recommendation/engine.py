@@ -1,0 +1,280 @@
+"""Recommendation template — ALS personal recommendations.
+
+Reference: examples/scala-parallel-recommendation (SURVEY.md §2.2) — the
+canonical MLlib-ALS template.  Contract preserved:
+
+- events: ``rate`` (user→item, properties.rating) and ``buy`` (user→item,
+  implicit, treated as rating 4.0)
+- query JSON: ``{"user": "u1", "num": 4}``
+- result JSON: ``{"itemScores": [{"item": "i1", "score": 1.2}, ...]}``
+- algorithm params: rank / numIterations / lambda / alpha / implicitPrefs /
+  seed — the MLlib ``ALS.train`` knob set
+
+Substrate: :mod:`predictionio_tpu.models.als` (batched XLA normal
+equations) instead of Spark MLlib; serving top-K is one MXU matmul +
+``lax.top_k`` rather than a JVM loop over ``recommendProducts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Preparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.data.event import BiMap
+from predictionio_tpu.models import als as als_lib
+
+__all__ = [
+    "engine",
+    "Query",
+    "ItemScore",
+    "PredictedResult",
+    "Ratings",
+    "DataSourceParams",
+    "RecommendationDataSource",
+    "RecommendationPreparator",
+    "ALSAlgorithmParams",
+    "ALSAlgorithm",
+    "ALSModelWrapper",
+]
+
+
+# -- query / result (JSON contract, Appendix A) -----------------------------
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: List[ItemScore]  # noqa: N815 — reference JSON field name
+
+
+# -- training data ----------------------------------------------------------
+
+@dataclasses.dataclass
+class Ratings:
+    """COO ratings plus the string↔int entity indexes.
+
+    Reference: the template's ``TrainingData(ratings: RDD[Rating])`` — here
+    the RDD is columnar numpy destined for device transfer, and the BiMaps
+    (reference: ``ALSModel`` members userStringIntMap/itemStringIntMap)
+    travel with the data.
+    """
+
+    user_ids: np.ndarray
+    item_ids: np.ndarray
+    ratings: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815 — engine.json key parity
+    eventNames: Sequence[str] = ("rate", "buy")  # noqa: N815
+    buyRating: float = 4.0  # noqa: N815 — implicit "buy" becomes this rating
+    evalK: Optional[int] = None  # noqa: N815 — folds for pio eval
+    evalQueryNum: int = 10  # noqa: N815
+    seed: int = 3
+
+
+class RecommendationDataSource(DataSource):
+    """Reads rate/buy events into COO ratings (reference: DataSource.scala)."""
+
+    params_class = DataSourceParams
+
+    def _read(self, ctx: RuntimeContext) -> Ratings:
+        p: DataSourceParams = self.params
+        table = ctx.event_store.find_columnar(
+            p.appName,
+            entity_type="user",
+            target_entity_type="item",
+            event_names=list(p.eventNames),
+        )
+        users = table.column("entity_id").to_pylist()
+        items = table.column("target_entity_id").to_pylist()
+        names = table.column("event").to_pylist()
+        props = table.column("properties_json").to_pylist()
+        ratings: List[float] = []
+        for name, pr in zip(names, props):
+            if name == "rate":
+                ratings.append(float(json.loads(pr or "{}").get("rating", 0.0)))
+            else:
+                ratings.append(p.buyRating)
+        user_index = BiMap.string_int(users)
+        item_index = BiMap.string_int(items)
+        return Ratings(
+            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
+            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
+            ratings=np.array(ratings, dtype=np.float32),
+            user_index=user_index,
+            item_index=item_index,
+        )
+
+    def read_training(self, ctx: RuntimeContext) -> Ratings:
+        return self._read(ctx)
+
+    def read_eval(self, ctx: RuntimeContext):
+        """K-fold split by rating index; queries ask top-N for each user with
+        held-out positives as actuals (reference: DataSource.readEval)."""
+        p: DataSourceParams = self.params
+        if not p.evalK:
+            return []
+        data = self._read(ctx)
+        n = len(data.user_ids)
+        rng = np.random.default_rng(p.seed)
+        fold_of = rng.integers(0, p.evalK, n)
+        folds = []
+        for k in range(p.evalK):
+            train_sel = fold_of != k
+            test_sel = ~train_sel
+            td = Ratings(
+                user_ids=data.user_ids[train_sel],
+                item_ids=data.item_ids[train_sel],
+                ratings=data.ratings[train_sel],
+                user_index=data.user_index,
+                item_index=data.item_index,
+            )
+            inv_user = data.user_index.inverse
+            inv_item = data.item_index.inverse
+            qa: Dict[str, set] = {}
+            for u, i, r in zip(data.user_ids[test_sel], data.item_ids[test_sel],
+                               data.ratings[test_sel]):
+                if r > 0:
+                    qa.setdefault(inv_user[u], set()).add(inv_item[i])
+            queries = [
+                (Query(user=u, num=p.evalQueryNum), sorted(actual))
+                for u, actual in sorted(qa.items())
+            ]
+            folds.append((td, None, queries))
+        return folds
+
+
+class RecommendationPreparator(Preparator):
+    """Reference: Preparator.scala — identity over the ratings."""
+
+    def prepare(self, ctx: RuntimeContext, training_data: Ratings) -> Ratings:
+        return training_data
+
+
+# -- algorithm --------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 10  # noqa: N815 — MLlib knob names
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    implicitPrefs: bool = False  # noqa: N815
+    maxDegree: Optional[int] = None  # noqa: N815 — ragged truncation cap
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ALSModelWrapper:
+    """Trained factors + indexes (reference: template ALSModel)."""
+
+    model: als_lib.ALSModel
+    user_index: BiMap
+    item_index: BiMap
+
+
+class ALSAlgorithm(Algorithm):
+    params_class = ALSAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: Ratings) -> ALSModelWrapper:
+        p: ALSAlgorithmParams = self.params
+        if len(prepared_data.user_ids) == 0:
+            raise ValueError(
+                "No rating events found — check appName/eventNames "
+                "(reference template raises the same assertion)."
+            )
+        cfg = als_lib.ALSConfig(
+            rank=p.rank,
+            iterations=p.numIterations,
+            reg=p.lambda_,
+            alpha=p.alpha,
+            implicit=p.implicitPrefs,
+            max_degree=p.maxDegree,
+            seed=p.seed if p.seed is not None else ctx.seed,
+        )
+        model = als_lib.train_als(
+            prepared_data.user_ids,
+            prepared_data.item_ids,
+            prepared_data.ratings,
+            n_users=len(prepared_data.user_index),
+            n_items=len(prepared_data.item_index),
+            config=cfg,
+            mesh=ctx.mesh,
+        )
+        return ALSModelWrapper(
+            model=model,
+            user_index=prepared_data.user_index,
+            item_index=prepared_data.item_index,
+        )
+
+    def predict(self, model: ALSModelWrapper, query: Query) -> PredictedResult:
+        uidx = model.user_index.get(query.user)
+        if uidx is None:
+            return PredictedResult(itemScores=[])  # unknown user (reference parity)
+        scores, ids = als_lib.recommend(
+            model.model, jnp.asarray([uidx]), min(query.num, len(model.item_index))
+        )
+        inv = model.item_index.inverse
+        return PredictedResult(
+            itemScores=[
+                ItemScore(item=inv[int(i)], score=float(s))
+                for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0]))
+            ]
+        )
+
+    def batch_predict(self, model: ALSModelWrapper, queries):
+        """Vectorized eval path: one batched matmul for all queries."""
+        known = [(i, q) for i, q in queries if q.user in model.user_index]
+        out = [(i, PredictedResult(itemScores=[])) for i, q in queries
+               if q.user not in model.user_index]
+        if known:
+            num = max(q.num for _, q in known)
+            uidx = jnp.asarray([model.user_index[q.user] for _, q in known])
+            scores, ids = als_lib.recommend(
+                model.model, uidx, min(num, len(model.item_index))
+            )
+            inv = model.item_index.inverse
+            for row, (i, q) in enumerate(known):
+                out.append((i, PredictedResult(itemScores=[
+                    ItemScore(item=inv[int(ii)], score=float(ss))
+                    for ss, ii in zip(np.asarray(scores[row])[: q.num],
+                                      np.asarray(ids[row])[: q.num])
+                ])))
+        return out
+
+
+def engine() -> Engine:
+    """Reference: RecommendationEngine EngineFactory."""
+    return Engine(
+        datasource_class=RecommendationDataSource,
+        preparator_class=RecommendationPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -35,11 +35,15 @@ import pytest  # noqa: E402
 
 @pytest.fixture()
 def pio_home(tmp_path, monkeypatch):
-    """Isolated PIO_HOME per test."""
+    """Isolated PIO_HOME per test (fresh storage singleton both sides)."""
+    from predictionio_tpu.data.storage import reset_storage
+
     home = tmp_path / "pio_home"
     home.mkdir()
     monkeypatch.setenv("PIO_HOME", str(home))
     for k in list(os.environ):
         if k.startswith("PIO_STORAGE_"):
             monkeypatch.delenv(k, raising=False)
-    return home
+    reset_storage()
+    yield home
+    reset_storage()

@@ -1,0 +1,115 @@
+"""End-to-end recommendation template: events → train workflow → predict.
+
+Mirrors the reference's quickstart integration scenario (SURVEY.md §4):
+app new → import events → train → query assertions, minus HTTP.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, Storage, get_storage
+from predictionio_tpu.templates.recommendation import Query, engine
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    storage = get_storage()
+    return RuntimeContext.create(storage=storage)
+
+
+def _seed_events(ctx, app_name="testapp", n_users=12, n_items=8, seed=0):
+    storage: Storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name=app_name))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(seed)
+    events = storage.get_events()
+    # Two taste cliques: even users like even items, odd like odd.
+    for u in range(n_users):
+        for i in range(n_items):
+            if i % 2 == u % 2 and rng.random() < 0.9:
+                events.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": float(3 + 2 * rng.random())}),
+                    ),
+                    app_id,
+                )
+    # A couple of implicit buys.
+    events.insert(
+        Event(event="buy", entity_type="user", entity_id="u0",
+              target_entity_type="item", target_entity_id="i2"),
+        app_id,
+    )
+    return app_id
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+    "datasource": {"params": {"appName": "testapp"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 8, "numIterations": 8, "lambda_": 0.01, "seed": 3}}
+    ],
+}
+
+
+def test_train_and_predict(ctx):
+    _seed_events(ctx)
+    eng = engine()
+    variant = EngineVariant.from_dict(VARIANT)
+    instance_id = run_train(eng, variant, ctx)
+    instance = ctx.storage.get_engine_instances().get(instance_id)
+    assert instance.status == "COMPLETED"
+
+    models = load_models(eng, instance, ctx)
+    algo = eng.make_algorithms(eng.bind_engine_params(VARIANT))[0]
+    result = algo.predict(models[0], Query(user="u0", num=4))
+    assert len(result.itemScores) == 4
+    # u0 is an even-clique user: top recs should skew even.
+    even = sum(1 for s in result.itemScores if int(s.item[1:]) % 2 == 0)
+    assert even >= 3
+    assert result.itemScores[0].score >= result.itemScores[-1].score
+
+
+def test_unknown_user_empty_result(ctx):
+    _seed_events(ctx)
+    eng = engine()
+    instance_id = run_train(eng, EngineVariant.from_dict(VARIANT), ctx)
+    instance = ctx.storage.get_engine_instances().get(instance_id)
+    models = load_models(eng, instance, ctx)
+    algo = eng.make_algorithms(eng.bind_engine_params(VARIANT))[0]
+    assert algo.predict(models[0], Query(user="nobody")).itemScores == []
+
+
+def test_no_events_fails_instance(ctx):
+    storage: Storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    eng = engine()
+    with pytest.raises(ValueError):
+        run_train(eng, EngineVariant.from_dict(VARIANT), ctx)
+    insts = storage.get_engine_instances().get_all()
+    assert insts and insts[0].status == "FAILED"
+
+
+def test_batch_predict_matches_single(ctx):
+    _seed_events(ctx)
+    eng = engine()
+    instance_id = run_train(eng, EngineVariant.from_dict(VARIANT), ctx)
+    instance = ctx.storage.get_engine_instances().get(instance_id)
+    models = load_models(eng, instance, ctx)
+    algo = eng.make_algorithms(eng.bind_engine_params(VARIANT))[0]
+    queries = [(0, Query(user="u0", num=3)), (1, Query(user="u1", num=3)),
+               (2, Query(user="ghost", num=3))]
+    batch = dict(algo.batch_predict(models[0], queries))
+    single0 = algo.predict(models[0], Query(user="u0", num=3))
+    assert [s.item for s in batch[0].itemScores] == [s.item for s in single0.itemScores]
+    assert batch[2].itemScores == []

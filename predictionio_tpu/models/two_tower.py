@@ -1,0 +1,240 @@
+"""Two-tower neural retrieval — the TPU-era flagship engine.
+
+Absent in the reference (SURVEY.md §2.2 marks it a new build target from
+BASELINE.json config 4): learned user/item embeddings + MLP towers trained
+with in-batch sampled-softmax negatives, retrieval = MIPS top-K over item
+embeddings.
+
+TPU design:
+- batch sharded over the ``data`` mesh axis (DP); the in-batch-negatives
+  logits matrix is [B, B] — each shard computes its slice against the
+  all-gathered item embeddings of the global batch (XLA inserts the
+  all-gather from the sharding annotations; it rides ICI).
+- embedding tables row-sharded over the ``model`` axis (the tables dominate
+  memory); MLP weights replicated (tiny).
+- matmuls in bfloat16 with f32 accumulation (MXU-native), params in f32.
+- the whole train step is ONE jitted function: grads via ``jax.grad``,
+  optax adam update inside.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
+from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+__all__ = ["TwoTowerConfig", "TwoTowerState", "init_state", "train_step",
+           "train", "encode_users", "encode_items", "retrieve"]
+
+
+@dataclasses.dataclass
+class TwoTowerConfig:
+    n_users: int
+    n_items: int
+    embed_dim: int = 64
+    hidden_dims: Tuple[int, ...] = (128,)
+    out_dim: int = 64
+    learning_rate: float = 1e-3
+    temperature: float = 0.05
+    batch_size: int = 1024
+    epochs: int = 5
+    seed: int = 0
+
+
+def _init_mlp(key, in_dim: int, hidden: Tuple[int, ...], out_dim: int) -> Dict:
+    layers = []
+    dims = (in_dim, *hidden, out_dim)
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        key, k = jax.random.split(key)
+        layers.append({
+            "w": jax.random.normal(k, (a, b), jnp.float32) * (2.0 / a) ** 0.5,
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return {"layers": layers}
+
+
+def _mlp(params: Dict, x: jax.Array) -> jax.Array:
+    h = x.astype(jnp.bfloat16)
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        h = jnp.einsum("bd,dh->bh", h, layer["w"].astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        h = h + layer["b"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+        h = h.astype(jnp.bfloat16)
+    return h.astype(jnp.float32)
+
+
+def init_params(cfg: TwoTowerConfig) -> Dict:
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, ki, ku2, ki2 = jax.random.split(key, 4)
+    scale = cfg.embed_dim ** -0.5
+    return {
+        "user_embed": jax.random.normal(ku, (cfg.n_users, cfg.embed_dim)) * scale,
+        "item_embed": jax.random.normal(ki, (cfg.n_items, cfg.embed_dim)) * scale,
+        "user_mlp": _init_mlp(ku2, cfg.embed_dim, cfg.hidden_dims, cfg.out_dim),
+        "item_mlp": _init_mlp(ki2, cfg.embed_dim, cfg.hidden_dims, cfg.out_dim),
+    }
+
+
+@dataclasses.dataclass
+class TwoTowerState:
+    params: Dict
+    opt_state: Any
+    step: jax.Array
+
+
+def _tx(cfg: TwoTowerConfig):
+    return optax.adam(cfg.learning_rate)
+
+
+def init_state(cfg: TwoTowerConfig, mesh: Optional[Mesh] = None) -> TwoTowerState:
+    params = init_params(cfg)
+    if mesh is not None:
+        params = jax.device_put(params, param_shardings(cfg, mesh))
+    opt_state = _tx(cfg).init(params)
+    return TwoTowerState(params=params, opt_state=opt_state,
+                         step=jnp.zeros((), jnp.int32))
+
+
+def param_shardings(cfg: TwoTowerConfig, mesh: Mesh):
+    """Embedding tables row-sharded over ``model``; MLPs replicated."""
+    def shard(path_leaf):
+        return NamedSharding(mesh, P(AXIS_MODEL, None))
+
+    rep = NamedSharding(mesh, P())
+    return {
+        "user_embed": shard("user_embed"),
+        "item_embed": shard("item_embed"),
+        "user_mlp": jax.tree.map(lambda _: rep, init_params(cfg)["user_mlp"]),
+        "item_mlp": jax.tree.map(lambda _: rep, init_params(cfg)["item_mlp"]),
+    }
+
+
+def _forward_users(params: Dict, user_ids: jax.Array) -> jax.Array:
+    e = params["user_embed"][user_ids]
+    z = _mlp(params["user_mlp"], e)
+    return z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-6)
+
+
+def _forward_items(params: Dict, item_ids: jax.Array) -> jax.Array:
+    e = params["item_embed"][item_ids]
+    z = _mlp(params["item_mlp"], e)
+    return z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-6)
+
+
+def _loss(params: Dict, user_ids, item_ids, weights, temperature: float):
+    """In-batch sampled softmax: positives on the diagonal.
+
+    Duplicate items inside the batch are masked out of the negatives (the
+    standard correction — otherwise a repeated positive is its own negative).
+    """
+    u = _forward_users(params, user_ids)       # [B, D]
+    v = _forward_items(params, item_ids)       # [B, D]
+    logits = jnp.einsum("bd,cd->bc", u, v,
+                        preferred_element_type=jnp.float32) / temperature
+    same = item_ids[:, None] == item_ids[None, :]
+    mask = same & ~jnp.eye(item_ids.shape[0], dtype=bool)
+    logits = jnp.where(mask, -1e9, logits)
+    labels = jnp.arange(item_ids.shape[0])
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _train_step_impl(state: Tuple, user_ids, item_ids, weights, cfg) -> Tuple:
+    params, opt_state, step = state
+    loss, grads = jax.value_and_grad(_loss)(params, user_ids, item_ids,
+                                            weights, cfg.temperature)
+    updates, opt_state = _tx(cfg).update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return (params, opt_state, step + 1), loss
+
+
+# dataclasses aren't pytrees; tuple in/out keeps jit donation simple.
+def train_step(state: TwoTowerState, user_ids, item_ids, weights,
+               cfg: TwoTowerConfig) -> Tuple[TwoTowerState, jax.Array]:
+    hcfg = _HashableConfig(cfg)
+    (p, o, s), loss = _train_step_impl(
+        (state.params, state.opt_state, state.step),
+        user_ids, item_ids, weights, hcfg)
+    return TwoTowerState(params=p, opt_state=o, step=s), loss
+
+
+class _HashableConfig:
+    """Static-arg wrapper: hash by the fields that change compilation."""
+
+    def __init__(self, cfg: TwoTowerConfig):
+        self._cfg = cfg
+        self._key = (cfg.temperature, cfg.learning_rate)
+
+    def __getattr__(self, name):
+        return getattr(self._cfg, name)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableConfig) and self._key == other._key
+
+
+def train(
+    user_ids: np.ndarray,
+    item_ids: np.ndarray,
+    cfg: TwoTowerConfig,
+    mesh: Optional[Mesh] = None,
+    weights: Optional[np.ndarray] = None,
+) -> TwoTowerState:
+    """Minibatch training loop over interaction pairs.
+
+    The trailing ragged batch is padded with weight-0 rows — fixed shapes,
+    one compilation (SURVEY.md §7 recompilation discipline).
+    """
+    n = len(user_ids)
+    if weights is None:
+        weights = np.ones(n, dtype=np.float32)
+    rng = np.random.default_rng(cfg.seed)
+    state = init_state(cfg, mesh)
+    bs = cfg.batch_size
+    batch_sharding = NamedSharding(mesh, P(AXIS_DATA)) if mesh is not None else None
+    for _ in range(cfg.epochs):
+        order = rng.permutation(n)
+        for start in range(0, n, bs):
+            sel = order[start:start + bs]
+            pad = bs - len(sel)
+            u = np.concatenate([user_ids[sel], np.zeros(pad, np.int64)])
+            i = np.concatenate([item_ids[sel], np.zeros(pad, np.int64)])
+            w = np.concatenate([weights[sel], np.zeros(pad, np.float32)])
+            args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
+            if batch_sharding is not None:
+                args = tuple(jax.device_put(a, batch_sharding) for a in args)
+            state, _ = train_step(state, *args, cfg)
+    return state
+
+
+def encode_users(params: Dict, user_ids: jax.Array) -> jax.Array:
+    return _forward_users(params, user_ids)
+
+
+def encode_items(params: Dict, item_ids: jax.Array) -> jax.Array:
+    return _forward_items(params, item_ids)
+
+
+def retrieve(params: Dict, user_ids: jax.Array, n_items: int, k: int,
+             *, chunk: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """Top-k MIPS over all item embeddings."""
+    q = _forward_users(params, user_ids)
+    all_items = _forward_items(params, jnp.arange(n_items))
+    if chunk:
+        return chunked_top_k(q, all_items, k, chunk=chunk)
+    return top_k_scores(q, all_items, k)

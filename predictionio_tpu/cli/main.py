@@ -273,6 +273,60 @@ def cmd_eval(args) -> int:
 
 
 # --------------------------------------------------------------------------
+# pio eventserver / deploy / dashboard
+# --------------------------------------------------------------------------
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.server import EventServer
+
+    srv = EventServer(storage=_storage(), host=args.ip, port=args.port)
+    srv.start(block=False)
+    print(f"Event Server listening on {args.ip}:{srv.port} "
+          "(Ctrl-C to stop)")
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.controller import EngineVariant, load_engine_factory
+    from predictionio_tpu.server import EngineServer
+
+    variant_path = Path(args.engine_json)
+    if not variant_path.exists():
+        _die(f"{variant_path} not found (expected an engine.json).")
+    variant = EngineVariant.from_file(variant_path)
+    engine = load_engine_factory(variant.engine_factory)()
+    srv = EngineServer(
+        engine, variant, _storage(), host=args.ip, port=args.port,
+        instance_id=args.engine_instance_id,
+    )
+    srv.start(block=False)
+    print(f"Engine Server listening on {args.ip}:{srv.port} "
+          f"(instance {srv._instance.id}; Ctrl-C to stop)")
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.server.dashboard import DashboardServer
+
+    srv = DashboardServer(storage=_storage(), host=args.ip, port=args.port)
+    srv.start(block=False)
+    print(f"Dashboard listening on {args.ip}:{srv.port} (Ctrl-C to stop)")
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+# --------------------------------------------------------------------------
 # pio import / export
 # --------------------------------------------------------------------------
 
@@ -388,6 +442,23 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--seed", type=int, default=0)
     e.add_argument("--output-json", dest="output_json")
     e.set_defaults(fn=cmd_eval)
+
+    es = sub.add_parser("eventserver", help="start the event ingestion server")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.set_defaults(fn=cmd_eventserver)
+
+    d = sub.add_parser("deploy", help="serve a trained engine over HTTP")
+    d.add_argument("--engine-json", default="engine.json")
+    d.add_argument("--ip", default="0.0.0.0")
+    d.add_argument("--port", type=int, default=8000)
+    d.add_argument("--engine-instance-id", dest="engine_instance_id")
+    d.set_defaults(fn=cmd_deploy)
+
+    db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
+    db.add_argument("--ip", default="0.0.0.0")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(fn=cmd_dashboard)
 
     imp = sub.add_parser("import", help="import NDJSON events")
     imp.add_argument("--appid", type=int, required=True)

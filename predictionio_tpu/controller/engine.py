@@ -73,11 +73,15 @@ class Engine:
         preparator_class: Type[Preparator] = IdentityPreparator,
         algorithm_classes: Optional[Dict[str, Type[Algorithm]]] = None,
         serving_class: Type[Serving] = FirstServing,
+        query_class: Optional[type] = None,
     ):
         self.datasource_class = datasource_class
         self.preparator_class = preparator_class
         self.algorithm_classes = dict(algorithm_classes or {})
         self.serving_class = serving_class
+        # Query dataclass for the deploy server's JSON binding (reference:
+        # the Query type param of Engine; JsonExtractor binds requests to it).
+        self.query_class = query_class
 
     # -- engine.json binding ----------------------------------------------
     def bind_engine_params(self, variant_json: Dict[str, Any]) -> EngineParams:

@@ -1,0 +1,18 @@
+"""HTTP server layer: Event Server (ingest) and Engine Server (deploy).
+
+Reference: data/.../data/api/EventServer.scala (akka-http, :7070) and
+core/.../workflow/CreateServer.scala (:8000) — SURVEY.md §3.2/§3.3.
+The REST surfaces (Appendix A) are preserved byte-for-byte where clients
+could depend on them: paths, query params, status codes, JSON shapes.
+
+Python's ``ThreadingHTTPServer`` stands in for akka-http: ingestion is
+storage-bound, not compute-bound, and the serving hot path delegates to a
+compiled XLA executable either way.  The C++ continuous-batching frontend
+(SURVEY.md §7 step 9) replaces the engine server's request loop when p50
+latency matters.
+"""
+
+from predictionio_tpu.server.event_server import EventServer
+from predictionio_tpu.server.engine_server import EngineServer
+
+__all__ = ["EventServer", "EngineServer"]

@@ -1,0 +1,142 @@
+"""Dashboard — read-only web UI over engine/evaluation instances.
+
+Reference: tools/.../tools/dashboard/Dashboard.scala (SURVEY.md §2.1): an
+HTML listing of engine instances (status, times, params) and completed
+evaluations with their metric scores.  JSON endpoints added for tooling:
+``GET /engine_instances.json``, ``GET /evaluation_instances.json``.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.version import __version__
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DashboardServer"]
+
+
+def _fmt_time(t) -> str:
+    return t.isoformat(timespec="seconds") if t else "-"
+
+
+class DashboardServer:
+    def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
+                 port: int = 9000):
+        self.storage = storage or get_storage()
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- payloads -----------------------------------------------------------
+
+    def _engine_rows(self):
+        rows = self.storage.get_engine_instances().get_all()
+        return sorted(rows, key=lambda r: r.start_time or 0, reverse=True)
+
+    def _eval_rows(self):
+        rows = self.storage.get_evaluation_instances().get_all()
+        return sorted(rows, key=lambda r: r.start_time or 0, reverse=True)
+
+    def _index_html(self) -> str:
+        eng = "".join(
+            f"<tr><td>{html.escape(r.id or '')}</td>"
+            f"<td>{html.escape(r.engine_factory)}</td>"
+            f"<td>{html.escape(r.engine_variant)}</td>"
+            f"<td>{html.escape(r.status)}</td>"
+            f"<td>{_fmt_time(r.start_time)}</td><td>{_fmt_time(r.end_time)}</td></tr>"
+            for r in self._engine_rows()
+        )
+        ev = "".join(
+            f"<tr><td>{html.escape(r.id or '')}</td>"
+            f"<td>{html.escape(r.evaluation_class)}</td>"
+            f"<td>{html.escape(r.status)}</td>"
+            f"<td>{_fmt_time(r.start_time)}</td>"
+            f"<td><pre>{html.escape(r.evaluator_results or '-')}</pre></td></tr>"
+            for r in self._eval_rows()
+        )
+        return f"""<!doctype html><html><head><title>PredictionIO-TPU Dashboard</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
+<body><h1>PredictionIO-TPU Dashboard <small>v{__version__}</small></h1>
+<h2>Engine instances</h2>
+<table><tr><th>ID</th><th>Factory</th><th>Variant</th><th>Status</th>
+<th>Start</th><th>End</th></tr>{eng}</table>
+<h2>Evaluation instances</h2>
+<table><tr><th>ID</th><th>Evaluation</th><th>Status</th><th>Start</th>
+<th>Results</th></tr>{ev}</table></body></html>"""
+
+    def handle(self, method: str, path: str) -> Tuple[int, str, str]:
+        if method != "GET":
+            return 404, "application/json", json.dumps({"message": "Not Found"})
+        if path == "/":
+            return 200, "text/html; charset=UTF-8", self._index_html()
+        if path == "/engine_instances.json":
+            rows = [
+                {"id": r.id, "status": r.status,
+                 "engineFactory": r.engine_factory,
+                 "variant": r.engine_variant,
+                 "startTime": _fmt_time(r.start_time),
+                 "endTime": _fmt_time(r.end_time)}
+                for r in self._engine_rows()
+            ]
+            return 200, "application/json", json.dumps(rows)
+        if path == "/evaluation_instances.json":
+            rows = [
+                {"id": r.id, "status": r.status,
+                 "evaluationClass": r.evaluation_class,
+                 "startTime": _fmt_time(r.start_time),
+                 "results": r.evaluator_results,
+                 "resultsJson": r.evaluator_results_json}
+                for r in self._eval_rows()
+            ]
+            return 200, "application/json", json.dumps(rows)
+        return 404, "application/json", json.dumps({"message": "Not Found"})
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802
+                status, ctype, payload = server_self.handle(
+                    "GET", urlparse(self.path).path)
+                data = payload.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                logger.debug("dashboard %s", fmt % args)
+
+        return Handler
+
+    def start(self, block: bool = False) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        logger.info("Dashboard listening on %s:%d", self.host, self.port)
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,273 @@
+"""Engine Server — the `pio deploy` target.
+
+Reference: core/.../workflow/CreateServer.scala (SURVEY.md §3.2): resolve
+the latest COMPLETED engine instance, load its models, answer
+``POST /queries.json`` through Algorithm.predict → Serving.serve, support
+hot-reload after retrain (``POST /reload``), and a status page at ``GET /``.
+
+The per-request path binds the query JSON to the engine's ``query_class``
+dataclass (reference: JsonExtractor), runs every algorithm, and serializes
+the served result back to JSON.  ``GET /metrics`` adds the rebuild's
+latency histogram (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from predictionio_tpu.controller import Engine, EngineVariant, RuntimeContext
+from predictionio_tpu.controller.params import bind_params
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.version import __version__
+from predictionio_tpu.workflow.core_workflow import (
+    WorkflowError,
+    instance_engine_params,
+    load_models,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EngineServer", "QueryError"]
+
+
+class QueryError(ValueError):
+    pass
+
+
+class _LatencyStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+        self.errors = 0
+        self.latencies_ms = collections.deque(maxlen=8192)
+
+    def record(self, ms: float, ok: bool) -> None:
+        with self.lock:
+            self.count += 1
+            if not ok:
+                self.errors += 1
+            self.latencies_ms.append(ms)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            lat = sorted(self.latencies_ms)
+            p = lambda q: lat[int(q * (len(lat) - 1))] if lat else 0.0  # noqa: E731
+            return {"requestCount": self.count, "errorCount": self.errors,
+                    "latencyMs": {"p50": p(0.5), "p95": p(0.95), "p99": p(0.99)}}
+
+    def prometheus(self) -> str:
+        s = self.snapshot()
+        lines = [
+            "# TYPE pio_query_requests_total counter",
+            f"pio_query_requests_total {s['requestCount']}",
+            f"pio_query_errors_total {s['errorCount']}",
+            "# TYPE pio_query_latency_ms summary",
+        ]
+        for q, v in s["latencyMs"].items():
+            lines.append(f'pio_query_latency_ms{{quantile="{q}"}} {v:.3f}')
+        return "\n".join(lines) + "\n"
+
+
+class EngineServer:
+    """Loads a trained engine instance and serves queries over HTTP.
+
+    Reference roles: MasterActor (lifecycle/reload supervision) and
+    ServerActor (request handling) collapse into this class — Python
+    threading + a swap-under-lock reload replaces actor supervision.
+    """
+
+    def __init__(
+        self,
+        engine: Engine,
+        variant: EngineVariant,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        *,
+        engine_id: Optional[str] = None,
+        engine_version: str = __version__,
+        instance_id: Optional[str] = None,
+    ):
+        self.engine = engine
+        self.variant = variant
+        self.storage = storage or get_storage()
+        self.ctx = RuntimeContext.create(storage=self.storage)
+        self.host = host
+        self.port = port
+        self.engine_id = engine_id or variant.engine_factory
+        self.engine_version = engine_version
+        self.requested_instance_id = instance_id
+        self.stats = _LatencyStats()
+        self._swap_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._instance = None
+        self._algorithms: List[Any] = []
+        self._models: List[Any] = []
+        self._serving = None
+        self._loaded_at: Optional[_dt.datetime] = None
+        self.reload()
+
+    # -- model lifecycle ----------------------------------------------------
+
+    def reload(self) -> str:
+        """(Re)load the latest COMPLETED instance (reference: /reload after
+        retrain — MasterActor swaps ServerActor)."""
+        instances = self.storage.get_engine_instances()
+        if self.requested_instance_id:
+            instance = instances.get(self.requested_instance_id)
+            if instance is None or instance.status != "COMPLETED":
+                raise WorkflowError(
+                    f"Engine instance {self.requested_instance_id!r} not found "
+                    "or not COMPLETED.")
+        else:
+            instance = instances.get_latest_completed(
+                self.engine_id, self.engine_version, self.variant.variant_id)
+            if instance is None:
+                raise WorkflowError(
+                    f"No COMPLETED engine instance for engine id "
+                    f"{self.engine_id!r} variant {self.variant.variant_id!r} — "
+                    "run `pio train` first.")
+        models = load_models(self.engine, instance, self.ctx)
+        engine_params = instance_engine_params(self.engine, instance)
+        algorithms = self.engine.make_algorithms(engine_params)
+        serving = self.engine.make_serving(engine_params)
+        with self._swap_lock:
+            self._instance = instance
+            self._models = models
+            self._algorithms = algorithms
+            self._serving = serving
+            self._loaded_at = _dt.datetime.now(_dt.timezone.utc)
+        logger.info("Engine server loaded instance %s", instance.id)
+        return instance.id
+
+    # -- query path ---------------------------------------------------------
+
+    def _bind_query(self, obj: Any):
+        if self.engine.query_class is None:
+            return obj
+        if dataclasses.is_dataclass(self.engine.query_class):
+            try:
+                return bind_params(self.engine.query_class, obj, _path="query")
+            except TypeError as e:
+                raise QueryError(str(e)) from e
+        return self.engine.query_class(**obj)
+
+    @staticmethod
+    def _result_to_json(result: Any) -> Any:
+        if dataclasses.is_dataclass(result) and not isinstance(result, type):
+            return dataclasses.asdict(result)
+        return result
+
+    def query(self, query_json: Any) -> Any:
+        """One predict round-trip (reference §3.2 hot path)."""
+        with self._swap_lock:
+            algorithms, models, serving = (
+                self._algorithms, self._models, self._serving)
+        q = self._bind_query(query_json)
+        q = serving.supplement(q)
+        predictions = [a.predict(m, q) for a, m in zip(algorithms, models)]
+        return self._result_to_json(serving.serve(q, predictions))
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def handle(self, method: str, path: str, body: bytes) -> Tuple[int, Any]:
+        try:
+            if path == "/" and method == "GET":
+                with self._swap_lock:
+                    inst = self._instance
+                    loaded = self._loaded_at
+                return 200, {
+                    "status": "alive",
+                    "engineFactory": self.variant.engine_factory,
+                    "variant": self.variant.variant_id,
+                    "engineInstanceId": inst.id if inst else None,
+                    "modelLoadedAt": loaded.isoformat() if loaded else None,
+                    "version": __version__,
+                }
+            if path == "/metrics" and method == "GET":
+                return 200, self.stats.prometheus()
+            if path == "/reload" and method == "POST":
+                instance_id = self.reload()
+                return 200, {"status": "reloaded",
+                             "engineInstanceId": instance_id}
+            if path == "/queries.json" and method == "POST":
+                t0 = time.perf_counter()
+                try:
+                    obj = json.loads(body.decode("utf-8"))
+                    result = self.query(obj)
+                    self.stats.record((time.perf_counter() - t0) * 1e3, True)
+                    return 200, result
+                except (QueryError, json.JSONDecodeError) as e:
+                    self.stats.record((time.perf_counter() - t0) * 1e3, False)
+                    return 400, {"message": str(e)}
+                except Exception:
+                    self.stats.record((time.perf_counter() - t0) * 1e3, False)
+                    logger.exception("query failed")
+                    return 500, {"message": "Internal server error."}
+            if path == "/stop" and method == "POST":
+                threading.Thread(target=self.stop, daemon=True).start()
+                return 200, {"status": "stopping"}
+            return 404, {"message": "Not Found"}
+        except Exception:
+            logger.exception("engine server internal error")
+            return 500, {"message": "Internal server error."}
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str):
+                parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload = server_self.handle(method, parsed.path, body)
+                if isinstance(payload, str):
+                    data = payload.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json; charset=UTF-8"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def log_message(self, fmt, *args):
+                logger.debug("engine-server %s", fmt % args)
+
+        return Handler
+
+    def start(self, block: bool = False) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        logger.info("Engine Server listening on %s:%d", self.host, self.port)
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

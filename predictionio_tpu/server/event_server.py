@@ -1,0 +1,292 @@
+"""Event Server — always-on ingestion REST service.
+
+Reference: data/.../data/api/EventServer.scala + EventServiceActor routes
+(SURVEY.md §3.3).  API parity (Appendix A):
+
+- ``POST /events.json?accessKey=K[&channel=C]`` → 201 ``{"eventId": ...}``
+- ``POST /batch/events.json`` → 200 ``[{"status":201,"eventId":...}, ...]``
+  (per-item status; malformed items get their error inline, max 50/batch)
+- ``GET /events.json?accessKey=K&...`` filters: startTime, untilTime,
+  entityType, entityId, event, targetEntityType, targetEntityId, limit,
+  reversed
+- ``GET /events/<id>.json`` / ``DELETE /events/<id>.json``
+- ``GET /`` → ``{"status": "alive"}``; ``GET /stats.json`` ingest counters
+  (reference keeps these behind a flag; always on here)
+- ``GET /metrics`` — rebuild addition (SURVEY.md §5.5): Prometheus-style
+  text exposition of request counters/latency
+
+Auth: accessKey query param or ``Authorization`` header (the reference
+accepts basic-auth with the key as username).  Per-key event allowlists
+enforced on write.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import datetime as _dt
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.data.event import EventValidationError
+from predictionio_tpu.data.json_support import (
+    event_from_json,
+    event_to_json,
+    parse_iso8601,
+)
+from predictionio_tpu.data.storage import Storage, StorageError, get_storage
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EventServer", "MAX_BATCH_SIZE"]
+
+MAX_BATCH_SIZE = 50  # reference: EventServer batch cap
+
+
+class _Stats:
+    """In-memory ingest counters (reference: Stats/StatsActor)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self.by_status: Dict[int, int] = collections.Counter()
+        self.by_event: Dict[str, int] = collections.Counter()
+        self.latencies_ms: collections.deque = collections.deque(maxlen=4096)
+
+    def record(self, status: int, event_name: Optional[str], ms: float) -> None:
+        with self.lock:
+            self.by_status[status] += 1
+            if event_name:
+                self.by_event[event_name] += 1
+            self.latencies_ms.append(ms)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            lat = sorted(self.latencies_ms)
+            p = lambda q: lat[int(q * (len(lat) - 1))] if lat else 0.0  # noqa: E731
+            return {
+                "startTime": self.start_time.isoformat(),
+                "statusCounts": {str(k): v for k, v in self.by_status.items()},
+                "eventCounts": dict(self.by_event),
+                "latencyMs": {"p50": p(0.5), "p95": p(0.95), "p99": p(0.99)},
+            }
+
+    def prometheus(self) -> str:
+        snap = self.snapshot()
+        lines = ["# TYPE pio_event_requests_total counter"]
+        for status, n in snap["statusCounts"].items():
+            lines.append(f'pio_event_requests_total{{status="{status}"}} {n}')
+        lines.append("# TYPE pio_event_request_latency_ms summary")
+        for q, v in snap["latencyMs"].items():
+            lines.append(f'pio_event_request_latency_ms{{quantile="{q}"}} {v:.3f}')
+        return "\n".join(lines) + "\n"
+
+
+class EventServer:
+    """Owns the HTTP server; one instance per process (reference: main)."""
+
+    def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
+                 port: int = 7070):
+        self.storage = storage or get_storage()
+        self.host = host
+        self.port = port
+        self.stats = _Stats()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request-handling core (transport-independent, used by tests) ------
+
+    def _auth(self, params: Dict[str, List[str]], headers) -> Tuple[Optional[Any], Optional[int]]:
+        """Resolve accessKey → AccessKey row; (None, status) on failure."""
+        key = None
+        if "accessKey" in params:
+            key = params["accessKey"][0]
+        else:
+            auth = headers.get("Authorization", "") if headers else ""
+            if auth.startswith("Basic "):
+                try:
+                    key = base64.b64decode(auth[6:]).decode().split(":")[0]
+                except Exception:
+                    key = None
+        if not key:
+            return None, 401
+        row = self.storage.get_access_keys().get(key)
+        if row is None:
+            return None, 401
+        return row, None
+
+    def _resolve_channel(self, app_id: int, params) -> Tuple[Optional[int], Optional[str]]:
+        if "channel" not in params:
+            return None, None
+        name = params["channel"][0]
+        chans = self.storage.get_channels().get_by_app_id(app_id)
+        match = next((c for c in chans if c.name == name), None)
+        if match is None:
+            return None, f"Invalid channel: {name}"
+        return match.id, None
+
+    def handle(self, method: str, path: str, params: Dict[str, List[str]],
+               body: bytes, headers=None) -> Tuple[int, Any]:
+        """Dispatch one request; returns (status, JSON-able payload)."""
+        try:
+            return self._handle(method, path, params, body, headers)
+        except (EventValidationError, StorageError) as e:
+            return 400, {"message": str(e)}
+        except json.JSONDecodeError as e:
+            return 400, {"message": f"Invalid JSON: {e}"}
+        except Exception:
+            logger.exception("Event server internal error")
+            return 500, {"message": "Internal server error."}
+
+    def _handle(self, method, path, params, body, headers) -> Tuple[int, Any]:
+        if path == "/" and method == "GET":
+            return 200, {"status": "alive"}
+        if path == "/stats.json" and method == "GET":
+            return 200, self.stats.snapshot()
+        if path == "/metrics" and method == "GET":
+            return 200, self.stats.prometheus()
+
+        key_row, err = self._auth(params, headers)
+        if err:
+            return err, {"message": "Invalid accessKey."}
+        channel_id, cerr = self._resolve_channel(key_row.app_id, params)
+        if cerr:
+            return 400, {"message": cerr}
+        events = self.storage.get_events()
+
+        if path == "/events.json" and method == "POST":
+            obj = json.loads(body.decode("utf-8"))
+            ev = event_from_json(obj)
+            if key_row.events and ev.event not in key_row.events:
+                return 403, {"message": f"Event {ev.event!r} not allowed by this key."}
+            event_id = events.insert(ev, key_row.app_id, channel_id)
+            return 201, {"eventId": event_id}
+
+        if path == "/batch/events.json" and method == "POST":
+            arr = json.loads(body.decode("utf-8"))
+            if not isinstance(arr, list):
+                return 400, {"message": "Batch body must be a JSON array."}
+            if len(arr) > MAX_BATCH_SIZE:
+                return 400, {"message":
+                             f"Batch size exceeds the limit of {MAX_BATCH_SIZE}."}
+            out = []
+            for item in arr:
+                try:
+                    ev = event_from_json(item)
+                    if key_row.events and ev.event not in key_row.events:
+                        out.append({"status": 403,
+                                    "message": f"Event {ev.event!r} not allowed."})
+                        continue
+                    event_id = events.insert(ev, key_row.app_id, channel_id)
+                    out.append({"status": 201, "eventId": event_id})
+                except (EventValidationError, StorageError) as e:
+                    out.append({"status": 400, "message": str(e)})
+            return 200, out
+
+        if path == "/events.json" and method == "GET":
+            q = {}
+            if "startTime" in params:
+                q["start_time"] = parse_iso8601(params["startTime"][0])
+            if "untilTime" in params:
+                q["until_time"] = parse_iso8601(params["untilTime"][0])
+            for http_name, kw in (("entityType", "entity_type"),
+                                  ("entityId", "entity_id"),
+                                  ("targetEntityType", "target_entity_type"),
+                                  ("targetEntityId", "target_entity_id")):
+                if http_name in params:
+                    q[kw] = params[http_name][0]
+            if "event" in params:
+                q["event_names"] = params["event"]
+            limit = int(params.get("limit", ["20"])[0])
+            if limit < -1:
+                return 400, {"message": "limit must be >= -1."}
+            q["limit"] = None if limit == -1 else limit
+            q["reversed"] = params.get("reversed", ["false"])[0].lower() == "true"
+            found = list(events.find(key_row.app_id, channel_id, **q))
+            if not found:
+                return 404, {"message": "Not Found"}
+            return 200, [event_to_json(e) for e in found]
+
+        if path.startswith("/events/") and path.endswith(".json"):
+            event_id = path[len("/events/"):-len(".json")]
+            if method == "GET":
+                ev = events.get(event_id, key_row.app_id, channel_id)
+                if ev is None:
+                    return 404, {"message": "Not Found"}
+                return 200, event_to_json(ev)
+            if method == "DELETE":
+                ok = events.delete(event_id, key_row.app_id, channel_id)
+                return (200, {"message": "Found"}) if ok else (404, {"message": "Not Found"})
+
+        return 404, {"message": "Not Found"}
+
+    # -- HTTP plumbing ------------------------------------------------------
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str):
+                t0 = time.perf_counter()
+                parsed = urlparse(self.path)
+                params = parse_qs(parsed.query)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload = server_self.handle(
+                    method, parsed.path, params, body, self.headers)
+                if isinstance(payload, str):  # /metrics text exposition
+                    data = payload.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json; charset=UTF-8"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                name = None
+                if method == "POST" and parsed.path == "/events.json" and status == 201:
+                    try:
+                        name = json.loads(body).get("event")
+                    except Exception:
+                        name = None
+                server_self.stats.record(status, name,
+                                         (time.perf_counter() - t0) * 1e3)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+            def log_message(self, fmt, *args):
+                logger.debug("event-server %s", fmt % args)
+
+        return Handler
+
+    def start(self, block: bool = False) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        logger.info("Event Server listening on %s:%d", self.host, self.port)
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

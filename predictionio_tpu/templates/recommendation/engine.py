@@ -277,4 +277,5 @@ def engine() -> Engine:
         preparator_class=RecommendationPreparator,
         algorithm_classes={"als": ALSAlgorithm},
         serving_class=FirstServing,
+        query_class=Query,
     )

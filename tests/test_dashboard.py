@@ -1,0 +1,51 @@
+"""Dashboard server: instance listings (reference: tools/dashboard)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage import get_storage
+from predictionio_tpu.server.dashboard import DashboardServer
+
+
+@pytest.fixture()
+def dash(pio_home):
+    import datetime as dt
+
+    from predictionio_tpu.data.storage import EngineInstance
+
+    storage = get_storage()
+    storage.get_engine_instances().insert(EngineInstance(
+        id=None, status="COMPLETED",
+        start_time=dt.datetime.now(dt.timezone.utc),
+        end_time=dt.datetime.now(dt.timezone.utc),
+        engine_id="x", engine_version="1", engine_variant="default",
+        engine_factory="pkg.mod:engine",
+        datasource_params="{}", preparator_params="{}",
+        algorithms_params="[]", serving_params="{}"))
+    srv = DashboardServer(storage=storage, host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_html_index(dash):
+    with urllib.request.urlopen(f"http://127.0.0.1:{dash.port}/", timeout=10) as r:
+        body = r.read().decode()
+    assert "pkg.mod:engine" in body and "COMPLETED" in body
+
+
+def test_json_listing(dash):
+    url = f"http://127.0.0.1:{dash.port}/engine_instances.json"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        rows = json.loads(r.read())
+    assert len(rows) == 1 and rows[0]["status"] == "COMPLETED"
+
+
+def test_404(dash):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"http://127.0.0.1:{dash.port}/nope", timeout=10)
+    assert ei.value.code == 404

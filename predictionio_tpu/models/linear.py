@@ -1,0 +1,114 @@
+"""Softmax / logistic regression — full-batch L-BFGS-free training.
+
+Reference: Spark MLlib logistic regression (gradient passes via
+``treeAggregate``) behind the classification template (SURVEY.md §2.2).
+TPU shape: the whole dataset lives on device (batch dim sharded over the
+``data`` axis), each optimization step is one jitted fused
+forward/backward; the hierarchical gradient reduction is XLA's ``psum``.
+Optimizer: optax adam — converges to the same optimum as MLlib's LBFGS on
+these convex problems.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import AXIS_DATA
+
+__all__ = ["LogisticRegressionConfig", "LogisticRegressionModel", "train", "predict_proba"]
+
+
+@dataclasses.dataclass
+class LogisticRegressionConfig:
+    n_classes: int
+    reg: float = 0.0            # L2 (MLlib regParam)
+    learning_rate: float = 0.1
+    steps: int = 200
+    seed: int = 0
+    standardize: bool = True    # MLlib standardizes features by default
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["weights", "bias", "mean", "scale"], meta_fields=[])
+@dataclasses.dataclass
+class LogisticRegressionModel:
+    weights: jax.Array   # [D, C]
+    bias: jax.Array      # [C]
+    mean: jax.Array      # [D] feature standardization
+    scale: jax.Array     # [D]
+
+
+def _loss(params, x, y_onehot, w_sample, reg):
+    logits = x @ params["w"] + params["b"]
+    ll = optax.softmax_cross_entropy(logits, y_onehot)
+    data = jnp.sum(ll * w_sample) / jnp.maximum(jnp.sum(w_sample), 1.0)
+    return data + reg * jnp.sum(params["w"] ** 2)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",), donate_argnums=())
+def _fit(x, y_onehot, w_sample, w0, b0, reg, lr, steps: int):
+    tx = optax.adam(lr)
+    params = {"w": w0, "b": b0}
+    opt_state = tx.init(params)
+
+    def body(carry, _):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(_loss)(params, x, y_onehot, w_sample, reg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    (params, _), losses = jax.lax.scan(body, (params, opt_state), None,
+                                       length=steps)
+    return params, losses
+
+
+def train(
+    x: np.ndarray,
+    y: np.ndarray,
+    cfg: LogisticRegressionConfig,
+    mesh: Optional[Mesh] = None,
+    sample_weight: Optional[np.ndarray] = None,
+) -> LogisticRegressionModel:
+    x = np.asarray(x, np.float32)
+    n, d = x.shape
+    if cfg.standardize:
+        mean = x.mean(axis=0)
+        scale = x.std(axis=0)
+        scale = np.where(scale < 1e-8, 1.0, scale)
+    else:
+        mean = np.zeros(d, np.float32)
+        scale = np.ones(d, np.float32)
+    xs = (x - mean) / scale
+    y_onehot = jax.nn.one_hot(jnp.asarray(y), cfg.n_classes, dtype=jnp.float32)
+    w_sample = jnp.asarray(
+        sample_weight if sample_weight is not None else np.ones(n, np.float32))
+    xj = jnp.asarray(xs)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(AXIS_DATA))
+        xj = jax.device_put(xj, sh)
+        y_onehot = jax.device_put(y_onehot, sh)
+        w_sample = jax.device_put(w_sample, sh)
+    w0 = jnp.zeros((d, cfg.n_classes), jnp.float32)
+    b0 = jnp.zeros((cfg.n_classes,), jnp.float32)
+    params, _ = _fit(xj, y_onehot, w_sample, w0, b0,
+                     jnp.float32(cfg.reg), jnp.float32(cfg.learning_rate),
+                     cfg.steps)
+    return LogisticRegressionModel(
+        weights=params["w"], bias=params["b"],
+        mean=jnp.asarray(mean), scale=jnp.asarray(scale))
+
+
+@jax.jit
+def predict_proba(model: LogisticRegressionModel, x: jax.Array) -> jax.Array:
+    xs = (jnp.asarray(x, jnp.float32) - model.mean) / model.scale
+    return jax.nn.softmax(xs @ model.weights + model.bias, axis=-1)

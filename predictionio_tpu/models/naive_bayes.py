@@ -1,0 +1,115 @@
+"""Gaussian / multinomial naive Bayes — one-pass sufficient statistics.
+
+Reference: Spark MLlib ``NaiveBayes.train`` as used by the classification
+template (SURVEY.md §2.2) and e2's CategoricalNaiveBayes (§2.1).  MLlib
+computes per-class counts with ``treeAggregate``; on TPU the same
+sufficient statistics are segment-sums on device, and the hierarchical
+reduction is a ``psum`` when the batch is sharded (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import AXIS_DATA
+
+__all__ = ["NaiveBayesModel", "train_multinomial", "train_gaussian",
+           "predict_log_proba"]
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["class_log_prior", "feature_log_prob", "means", "variances"],
+    meta_fields=["kind"])
+@dataclasses.dataclass
+class NaiveBayesModel:
+    kind: str                 # "multinomial" | "gaussian"
+    class_log_prior: jax.Array      # [C]
+    # multinomial: feature log-likelihoods [C, D]
+    # gaussian: means [C, D] and variances [C, D]
+    feature_log_prob: Optional[jax.Array] = None
+    means: Optional[jax.Array] = None
+    variances: Optional[jax.Array] = None
+
+
+def _one_hot_counts(labels: jax.Array, n_classes: int) -> jax.Array:
+    return jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+
+
+@jax.jit
+def _multinomial_stats(x: jax.Array, y_onehot: jax.Array):
+    class_count = jnp.sum(y_onehot, axis=0)                      # [C]
+    feat_count = jnp.einsum("bc,bd->cd", y_onehot, x,
+                            preferred_element_type=jnp.float32)  # [C, D]
+    return class_count, feat_count
+
+
+def train_multinomial(
+    x: np.ndarray, y: np.ndarray, n_classes: int, *,
+    alpha: float = 1.0, mesh: Optional[Mesh] = None,
+) -> NaiveBayesModel:
+    """MLlib-parity multinomial NB with Laplace smoothing ``alpha``."""
+    xj = jnp.asarray(x, jnp.float32)
+    yj = _one_hot_counts(jnp.asarray(y), n_classes)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(AXIS_DATA))
+        xj = jax.device_put(xj, sh)
+        yj = jax.device_put(yj, sh)
+    class_count, feat_count = _multinomial_stats(xj, yj)
+    log_prior = jnp.log(class_count) - jnp.log(jnp.sum(class_count))
+    smoothed = feat_count + alpha
+    log_prob = jnp.log(smoothed) - jnp.log(
+        jnp.sum(smoothed, axis=1, keepdims=True))
+    return NaiveBayesModel(kind="multinomial", class_log_prior=log_prior,
+                           feature_log_prob=log_prob)
+
+
+@jax.jit
+def _gaussian_stats(x: jax.Array, y_onehot: jax.Array):
+    class_count = jnp.sum(y_onehot, axis=0)
+    s1 = jnp.einsum("bc,bd->cd", y_onehot, x,
+                    preferred_element_type=jnp.float32)
+    s2 = jnp.einsum("bc,bd->cd", y_onehot, x * x,
+                    preferred_element_type=jnp.float32)
+    return class_count, s1, s2
+
+
+def train_gaussian(
+    x: np.ndarray, y: np.ndarray, n_classes: int, *,
+    var_smoothing: float = 1e-6, mesh: Optional[Mesh] = None,
+) -> NaiveBayesModel:
+    xj = jnp.asarray(x, jnp.float32)
+    yj = _one_hot_counts(jnp.asarray(y), n_classes)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(AXIS_DATA))
+        xj = jax.device_put(xj, sh)
+        yj = jax.device_put(yj, sh)
+    n, s1, s2 = _gaussian_stats(xj, yj)
+    n_safe = jnp.maximum(n, 1.0)[:, None]
+    means = s1 / n_safe
+    variances = jnp.maximum(s2 / n_safe - means ** 2, 0.0) + var_smoothing
+    log_prior = jnp.log(jnp.maximum(n, 1e-12)) - jnp.log(jnp.sum(n))
+    return NaiveBayesModel(kind="gaussian", class_log_prior=log_prior,
+                           means=means, variances=variances)
+
+
+def predict_log_proba(model: NaiveBayesModel, x: jax.Array) -> jax.Array:
+    """[B, C] unnormalized class log-posteriors."""
+    x = jnp.asarray(x, jnp.float32)
+    if model.kind == "multinomial":
+        return model.class_log_prior[None, :] + jnp.einsum(
+            "bd,cd->bc", x, model.feature_log_prob,
+            preferred_element_type=jnp.float32)
+    ll = -0.5 * (
+        jnp.log(2 * jnp.pi * model.variances)[None, :, :]
+        + (x[:, None, :] - model.means[None, :, :]) ** 2
+        / model.variances[None, :, :]
+    ).sum(axis=-1)
+    return model.class_log_prior[None, :] + ll

@@ -1,0 +1,33 @@
+from predictionio_tpu.templates.classification.engine import (
+    ClassificationDataSource,
+    DataSourceParams,
+    LabeledData,
+    LRAlgorithm,
+    LRAlgorithmParams,
+    NaiveBayesAlgorithm,
+    NaiveBayesAlgorithmParams,
+    PredictedResult,
+    Query,
+    engine,
+)
+from predictionio_tpu.templates.classification.evaluation import (
+    AccuracyEvaluation,
+    default_params_generator,
+    evaluation,
+)
+
+__all__ = [
+    "ClassificationDataSource",
+    "DataSourceParams",
+    "LabeledData",
+    "LRAlgorithm",
+    "LRAlgorithmParams",
+    "NaiveBayesAlgorithm",
+    "NaiveBayesAlgorithmParams",
+    "PredictedResult",
+    "Query",
+    "engine",
+    "AccuracyEvaluation",
+    "default_params_generator",
+    "evaluation",
+]

@@ -1,0 +1,219 @@
+"""Classification template — attribute → label prediction.
+
+Reference: examples/scala-parallel-classification (SURVEY.md §2.2):
+``$set`` events on "user" entities carry numeric attributes (``attr0``,
+``attr1``, ``attr2``) and a label property (``plan``); MLlib NaiveBayes or
+logistic regression learns label | attrs.  Contract preserved:
+
+- query JSON: ``{"attr0": 2.0, "attr1": 0.0, "attr2": 1.0}``
+- result JSON: ``{"label": 2.0}``
+- ``$set`` aggregation semantics: latest property value per entity wins
+  (the reference's PropertyMap fold — SURVEY.md §7 hard parts)
+
+Substrate: :mod:`models.naive_bayes` (one-pass psum statistics) and
+:mod:`models.linear` (fused jit gradient steps) instead of MLlib.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.models import linear as lr_lib
+from predictionio_tpu.models import naive_bayes as nb_lib
+
+__all__ = [
+    "Query", "PredictedResult", "LabeledData", "DataSourceParams",
+    "ClassificationDataSource", "NaiveBayesAlgorithmParams",
+    "NaiveBayesAlgorithm", "LRAlgorithmParams", "LRAlgorithm", "engine",
+]
+
+
+@dataclasses.dataclass
+class Query:
+    attr0: float = 0.0
+    attr1: float = 0.0
+    attr2: float = 0.0
+
+    def vector(self, attrs: Sequence[str]) -> np.ndarray:
+        return np.array([getattr(self, a, 0.0) for a in attrs], np.float32)
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    label: float
+
+
+@dataclasses.dataclass
+class LabeledData:
+    """Dense feature matrix + integer labels + the label decode table."""
+
+    x: np.ndarray            # [N, D] float32
+    y: np.ndarray            # [N] int64 — indices into `classes`
+    classes: np.ndarray      # [C] original label values (float)
+    attrs: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815
+    entityType: str = "user"  # noqa: N815
+    attrs: Sequence[str] = ("attr0", "attr1", "attr2")
+    labelAttr: str = "plan"  # noqa: N815
+    evalK: Optional[int] = None  # noqa: N815
+    seed: int = 3
+
+
+class ClassificationDataSource(DataSource):
+    """Aggregates ``$set`` properties into (attrs, label) rows.
+
+    Reference: DataSource.scala — ``PEventStore.aggregateProperties`` with
+    required fields; entities missing any attr or the label are skipped.
+    """
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> LabeledData:
+        p: DataSourceParams = self.params
+        required = list(p.attrs) + [p.labelAttr]
+        props = ctx.event_store.aggregate_properties(
+            p.appName, p.entityType, required=required)
+        xs, labels = [], []
+        for _entity, pm in sorted(props.items()):
+            xs.append([float(pm.get(a)) for a in p.attrs])
+            labels.append(float(pm.get(p.labelAttr)))
+        if not xs:
+            raise ValueError(
+                f"No entities with properties {required} found in app "
+                f"{p.appName!r} (reference template raises the same).")
+        x = np.asarray(xs, np.float32)
+        label_arr = np.asarray(labels, np.float32)
+        classes = np.unique(label_arr)
+        y = np.searchsorted(classes, label_arr)
+        return LabeledData(x=x, y=y, classes=classes, attrs=tuple(p.attrs))
+
+    def read_eval(self, ctx: RuntimeContext):
+        p: DataSourceParams = self.params
+        if not p.evalK:
+            return []
+        data = self.read_training(ctx)
+        rng = np.random.default_rng(p.seed)
+        fold_of = rng.integers(0, p.evalK, len(data.y))
+        folds = []
+        for k in range(p.evalK):
+            tr = fold_of != k
+            te = ~tr
+            td = LabeledData(x=data.x[tr], y=data.y[tr], classes=data.classes,
+                             attrs=data.attrs)
+            qa = [
+                (Query(**{a: float(v) for a, v in zip(data.attrs, row)}),
+                 float(data.classes[lbl]))
+                for row, lbl in zip(data.x[te], data.y[te])
+            ]
+            folds.append((td, None, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesAlgorithmParams(Params):
+    lambda_: float = 1.0      # Laplace smoothing (reference NB param "lambda")
+    modelType: str = "multinomial"  # noqa: N815 — or "gaussian"
+
+
+@dataclasses.dataclass
+class NBModelWrapper:
+    model: nb_lib.NaiveBayesModel
+    classes: np.ndarray
+    attrs: Tuple[str, ...]
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_class = NaiveBayesAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: LabeledData) -> NBModelWrapper:
+        p: NaiveBayesAlgorithmParams = self.params
+        if p.modelType == "gaussian":
+            model = nb_lib.train_gaussian(
+                prepared_data.x, prepared_data.y, len(prepared_data.classes),
+                mesh=ctx.mesh)
+        else:
+            model = nb_lib.train_multinomial(
+                prepared_data.x, prepared_data.y, len(prepared_data.classes),
+                alpha=p.lambda_, mesh=ctx.mesh)
+        return NBModelWrapper(model=model, classes=prepared_data.classes,
+                              attrs=prepared_data.attrs)
+
+    def predict(self, model: NBModelWrapper, query: Query) -> PredictedResult:
+        x = query.vector(model.attrs)[None, :]
+        lp = nb_lib.predict_log_proba(model.model, jnp.asarray(x))
+        return PredictedResult(label=float(model.classes[int(np.argmax(lp[0]))]))
+
+    def batch_predict(self, model: NBModelWrapper, queries):
+        x = np.stack([q.vector(model.attrs) for _, q in queries])
+        lp = np.asarray(nb_lib.predict_log_proba(model.model, jnp.asarray(x)))
+        best = lp.argmax(axis=1)
+        return [(i, PredictedResult(label=float(model.classes[b])))
+                for (i, _), b in zip(queries, best)]
+
+
+@dataclasses.dataclass(frozen=True)
+class LRAlgorithmParams(Params):
+    regParam: float = 0.0  # noqa: N815 — MLlib knob names
+    maxIter: int = 200  # noqa: N815
+    stepSize: float = 0.1  # noqa: N815
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LRModelWrapper:
+    model: lr_lib.LogisticRegressionModel
+    classes: np.ndarray
+    attrs: Tuple[str, ...]
+
+
+class LRAlgorithm(Algorithm):
+    params_class = LRAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: LabeledData) -> LRModelWrapper:
+        p: LRAlgorithmParams = self.params
+        cfg = lr_lib.LogisticRegressionConfig(
+            n_classes=len(prepared_data.classes), reg=p.regParam,
+            learning_rate=p.stepSize, steps=p.maxIter, seed=p.seed)
+        model = lr_lib.train(prepared_data.x, prepared_data.y, cfg, mesh=ctx.mesh)
+        return LRModelWrapper(model=model, classes=prepared_data.classes,
+                              attrs=prepared_data.attrs)
+
+    def predict(self, model: LRModelWrapper, query: Query) -> PredictedResult:
+        x = query.vector(model.attrs)[None, :]
+        proba = lr_lib.predict_proba(model.model, jnp.asarray(x))
+        return PredictedResult(label=float(model.classes[int(np.argmax(proba[0]))]))
+
+    def batch_predict(self, model: LRModelWrapper, queries):
+        x = np.stack([q.vector(model.attrs) for _, q in queries])
+        proba = np.asarray(lr_lib.predict_proba(model.model, jnp.asarray(x)))
+        best = proba.argmax(axis=1)
+        return [(i, PredictedResult(label=float(model.classes[b])))
+                for (i, _), b in zip(queries, best)]
+
+
+def engine() -> Engine:
+    """Reference: ClassificationEngine EngineFactory."""
+    return Engine(
+        datasource_class=ClassificationDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_classes={"naive": NaiveBayesAlgorithm, "lr": LRAlgorithm},
+        serving_class=FirstServing,
+        query_class=Query,
+    )

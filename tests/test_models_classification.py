@@ -1,0 +1,72 @@
+"""naive_bayes + linear models vs sklearn-free numpy oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from predictionio_tpu.models import linear as lr_lib
+from predictionio_tpu.models import naive_bayes as nb_lib
+from predictionio_tpu.parallel.mesh import make_mesh
+
+
+def _blobs(seed=0, n=240, d=3, c=3):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((c, d)) * 4
+    y = np.repeat(np.arange(c), n // c)
+    x = centers[y] + rng.standard_normal((n, d))
+    return x.astype(np.float32), y
+
+
+class TestNaiveBayes:
+    def test_multinomial_matches_oracle(self):
+        rng = np.random.default_rng(1)
+        x = rng.poisson(3, (60, 4)).astype(np.float32)
+        y = rng.integers(0, 2, 60)
+        m = nb_lib.train_multinomial(x, y, 2, alpha=1.0)
+        # Oracle: standard smoothed count ratios.
+        for c in range(2):
+            counts = x[y == c].sum(axis=0) + 1.0
+            expect = np.log(counts / counts.sum())
+            np.testing.assert_allclose(np.asarray(m.feature_log_prob[c]),
+                                       expect, rtol=1e-5)
+            np.testing.assert_allclose(float(m.class_log_prior[c]),
+                                       np.log((y == c).mean()), rtol=1e-5)
+
+    def test_gaussian_classifies_blobs(self):
+        x, y = _blobs()
+        m = nb_lib.train_gaussian(x, y, 3)
+        pred = np.asarray(nb_lib.predict_log_proba(m, jnp.asarray(x))).argmax(1)
+        assert (pred == y).mean() > 0.95
+
+    def test_mesh_equivalence(self):
+        x, y = _blobs(seed=2)
+        m1 = nb_lib.train_multinomial(np.abs(x), y, 3)
+        mesh = make_mesh({"data": 8})
+        m2 = nb_lib.train_multinomial(np.abs(x), y, 3, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(m1.feature_log_prob),
+                                   np.asarray(m2.feature_log_prob),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLogisticRegression:
+    def test_separable_blobs(self):
+        x, y = _blobs(seed=3)
+        cfg = lr_lib.LogisticRegressionConfig(n_classes=3, steps=300,
+                                              learning_rate=0.3)
+        m = lr_lib.train(x, y, cfg)
+        pred = np.asarray(lr_lib.predict_proba(m, jnp.asarray(x))).argmax(1)
+        assert (pred == y).mean() > 0.95
+
+    def test_probabilities_normalized(self):
+        x, y = _blobs(seed=4)
+        cfg = lr_lib.LogisticRegressionConfig(n_classes=3, steps=50)
+        m = lr_lib.train(x, y, cfg)
+        p = np.asarray(lr_lib.predict_proba(m, jnp.asarray(x[:5])))
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_regularization_shrinks_weights(self):
+        x, y = _blobs(seed=5)
+        cfg0 = lr_lib.LogisticRegressionConfig(n_classes=3, steps=200, reg=0.0)
+        cfg1 = lr_lib.LogisticRegressionConfig(n_classes=3, steps=200, reg=0.5)
+        w0 = np.abs(np.asarray(lr_lib.train(x, y, cfg0).weights)).sum()
+        w1 = np.abs(np.asarray(lr_lib.train(x, y, cfg1).weights)).sum()
+        assert w1 < w0

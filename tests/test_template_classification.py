@@ -1,0 +1,96 @@
+"""Classification template: $set aggregation → NB/LR → predict → eval sweep."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.templates.classification import (
+    Query,
+    default_params_generator,
+    engine,
+    evaluation,
+)
+from predictionio_tpu.workflow.core_workflow import (
+    load_models,
+    run_evaluation,
+    run_train,
+)
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _seed(ctx, n=120, seed=0):
+    """Three separable classes on attr0..attr2 counts (NB-friendly)."""
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(seed)
+    events = storage.get_events()
+    for i in range(n):
+        label = i % 3
+        base = np.zeros(3)
+        base[label] = 6
+        attrs = rng.poisson(base + 1).astype(float)
+        events.insert(
+            Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                  properties=DataMap({"attr0": attrs[0], "attr1": attrs[1],
+                                      "attr2": attrs[2], "plan": float(label)})),
+            app_id)
+    # One user updates their label later — last-write-wins must apply.
+    events.insert(
+        Event(event="$set", entity_type="user", entity_id="u0",
+              properties=DataMap({"plan": 2.0})), app_id)
+    return app_id
+
+
+def _variant(algo):
+    return EngineVariant.from_dict({
+        "engineFactory": "predictionio_tpu.templates.classification:engine",
+        "datasource": {"params": {"appName": "testapp"}},
+        "algorithms": [algo],
+    })
+
+
+@pytest.mark.parametrize("algo", [
+    {"name": "naive", "params": {"lambda_": 1.0}},
+    {"name": "lr", "params": {"maxIter": 150, "stepSize": 0.3}},
+])
+def test_train_predict(ctx, algo):
+    _seed(ctx)
+    eng = engine()
+    variant = _variant(algo)
+    instance_id = run_train(eng, variant, ctx)
+    instance = ctx.storage.get_engine_instances().get(instance_id)
+    models = load_models(eng, instance, ctx)
+    a = eng.make_algorithms(eng.bind_engine_params(variant.raw))[0]
+    assert a.predict(models[0], Query(attr0=9, attr1=1, attr2=1)).label == 0.0
+    assert a.predict(models[0], Query(attr0=1, attr1=9, attr2=1)).label == 1.0
+    assert a.predict(models[0], Query(attr0=1, attr1=1, attr2=9)).label == 2.0
+
+
+def test_set_aggregation_last_write_wins(ctx):
+    _seed(ctx, n=9)
+    eng = engine()
+    ds = eng.datasource_class(eng.bind_engine_params(
+        _variant({"name": "naive"}).raw).datasource_params)
+    data = ds.read_training(ctx)
+    # u0 was class 0 then re-$set to plan=2.0.
+    i = sorted(f"u{j}" for j in range(9)).index("u0")
+    assert data.classes[data.y[i]] == 2.0
+
+
+def test_eval_sweep(ctx):
+    _seed(ctx)
+    ev = evaluation()
+    gen = default_params_generator("testapp", eval_k=2, lambdas=(0.5, 1.0))
+    instance_id, result = run_evaluation(ev, gen, ctx)
+    assert result.best_score > 0.7  # separable classes → high accuracy
+    assert len(result.candidate_scores) == 2
+    inst = ctx.storage.get_evaluation_instances().get(instance_id)
+    assert inst.status == "EVALCOMPLETED"
+    assert "Accuracy" in inst.evaluator_results

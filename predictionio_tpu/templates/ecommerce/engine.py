@@ -1,0 +1,263 @@
+"""E-commerce recommendation template — ALS + live business rules.
+
+Reference: examples/scala-parallel-ecommercerecommendation (SURVEY.md
+§2.2): implicit ALS on view/buy events, but serving applies *realtime*
+business rules the recommendation template doesn't have:
+
+- exclude items the user has already seen (``LEventStore.findByEntity`` at
+  predict time — the per-request storage round-trip of §3.2)
+- exclude globally unavailable items (``$set`` events on a "constraint"
+  entity ``unavailableItems`` with an ``items`` list property)
+- query-level ``categories`` / ``whiteList`` / ``blackList`` filters
+- unknown users fall back to popularity (view-count) ranking — the
+  reference returns popular items when the user has no factors
+
+Query/result JSON matches the reference:
+``{"user": "u1", "num": 4, "categories"?, "whiteList"?, "blackList"?}`` →
+``{"itemScores": [{"item", "score"}]}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.data.event import BiMap
+from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.ops.topk import top_k_scores
+
+__all__ = [
+    "Query", "ItemScore", "PredictedResult", "TrainingData",
+    "DataSourceParams", "ECommerceDataSource", "ECommAlgorithmParams",
+    "ECommAlgorithm", "engine",
+]
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+    categories: Optional[List[str]] = None
+    whiteList: Optional[List[str]] = None  # noqa: N815
+    blackList: Optional[List[str]] = None  # noqa: N815
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: List[ItemScore]  # noqa: N815
+
+
+@dataclasses.dataclass
+class TrainingData:
+    user_ids: np.ndarray
+    item_ids: np.ndarray
+    weights: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+    item_categories: Dict[str, Set[str]]
+    view_counts: np.ndarray  # [I] popularity fallback
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815
+    eventNames: Sequence[str] = ("view", "buy")  # noqa: N815
+    buyWeight: float = 5.0  # noqa: N815 — buys count more than views
+
+
+class ECommerceDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> TrainingData:
+        p: DataSourceParams = self.params
+        table = ctx.event_store.find_columnar(
+            p.appName, entity_type="user", target_entity_type="item",
+            event_names=list(p.eventNames))
+        users = table.column("entity_id").to_pylist()
+        items = table.column("target_entity_id").to_pylist()
+        names = table.column("event").to_pylist()
+        weights = np.array(
+            [p.buyWeight if n == "buy" else 1.0 for n in names], np.float32)
+        props = ctx.event_store.aggregate_properties(p.appName, "item")
+        cats: Dict[str, Set[str]] = {}
+        for item, pm in props.items():
+            c = pm.get("categories")
+            if c:
+                cats[item] = set(c)
+        user_index = BiMap.string_int(users)
+        item_index = BiMap.string_int(items)
+        item_ids = np.array([item_index[i] for i in items], dtype=np.int64)
+        view_counts = np.bincount(item_ids, weights=weights,
+                                  minlength=len(item_index)).astype(np.float32)
+        return TrainingData(
+            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
+            item_ids=item_ids,
+            weights=weights,
+            user_index=user_index,
+            item_index=item_index,
+            item_categories=cats,
+            view_counts=view_counts,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    appName: str  # noqa: N815 — serving reads live events from this app
+    rank: int = 10
+    numIterations: int = 10  # noqa: N815
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seenEvents: Sequence[str] = ("view", "buy")  # noqa: N815
+    unseenOnly: bool = True  # noqa: N815
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ECommModel:
+    user_factors: np.ndarray
+    item_factors: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+    item_categories: Dict[str, Set[str]]
+    view_counts: np.ndarray
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self._ctx: Optional[RuntimeContext] = None
+
+    def train(self, ctx: RuntimeContext, prepared_data: TrainingData) -> ECommModel:
+        p: ECommAlgorithmParams = self.params
+        if len(prepared_data.user_ids) == 0:
+            raise ValueError("No view/buy events found — check appName.")
+        self._ctx = ctx
+        cfg = als_lib.ALSConfig(
+            rank=p.rank, iterations=p.numIterations, reg=p.lambda_,
+            alpha=p.alpha, implicit=True,
+            seed=p.seed if p.seed is not None else ctx.seed)
+        model = als_lib.train_als(
+            prepared_data.user_ids, prepared_data.item_ids,
+            prepared_data.weights,
+            n_users=len(prepared_data.user_index),
+            n_items=len(prepared_data.item_index),
+            config=cfg, mesh=ctx.mesh)
+        return ECommModel(
+            user_factors=np.asarray(model.user_factors),
+            item_factors=np.asarray(model.item_factors),
+            user_index=prepared_data.user_index,
+            item_index=prepared_data.item_index,
+            item_categories=prepared_data.item_categories,
+            view_counts=prepared_data.view_counts,
+        )
+
+    # -- realtime lookups (reference: LEventStore at predict time) ---------
+
+    def _event_store(self, ctx: Optional[RuntimeContext]):
+        ctx = ctx or self._ctx
+        if ctx is None:
+            from predictionio_tpu.controller import RuntimeContext as RC
+
+            ctx = self._ctx = RC.create()
+        return ctx.event_store
+
+    def _seen_items(self, query: Query) -> Set[str]:
+        p: ECommAlgorithmParams = self.params
+        if not p.unseenOnly:
+            return set()
+        try:
+            evs = self._event_store(None).find_by_entity(
+                p.appName, "user", query.user,
+                event_names=list(p.seenEvents), limit=512)
+        except Exception:
+            return set()
+        return {e.target_entity_id for e in evs if e.target_entity_id}
+
+    def _unavailable_items(self) -> Set[str]:
+        """Latest $set on constraint/unavailableItems (reference parity)."""
+        try:
+            evs = self._event_store(None).find_by_entity(
+                self.params.appName, "constraint", "unavailableItems",
+                event_names=["$set"], limit=1)
+        except Exception:
+            return set()
+        for e in evs:
+            items = e.properties.get("items")
+            if items:
+                return set(items)
+        return set()
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        n_items = model.item_factors.shape[0]
+        inv = model.item_index.inverse
+        exclude = np.zeros((1, n_items), dtype=bool)
+
+        for name in self._seen_items(query) | self._unavailable_items():
+            idx = model.item_index.get(name)
+            if idx is not None:
+                exclude[0, idx] = True
+        if query.categories is not None:
+            want = set(query.categories)
+            for idx in range(n_items):
+                if not (model.item_categories.get(inv[idx], set()) & want):
+                    exclude[0, idx] = True
+        if query.whiteList is not None:
+            allowed = {model.item_index[i] for i in query.whiteList
+                       if i in model.item_index}
+            for idx in range(n_items):
+                if idx not in allowed:
+                    exclude[0, idx] = True
+        if query.blackList:
+            for i in query.blackList:
+                if i in model.item_index:
+                    exclude[0, model.item_index[i]] = True
+
+        uidx = model.user_index.get(query.user)
+        if uidx is not None:
+            q = jnp.asarray(model.user_factors[uidx][None, :])
+            scores, ids = top_k_scores(
+                q, jnp.asarray(model.item_factors),
+                min(query.num, n_items), exclude=jnp.asarray(exclude))
+            pairs = [(float(s), int(i))
+                     for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0]))
+                     if s > -1e37]
+        else:
+            # Popularity fallback (reference: predictDefault).
+            counts = np.where(exclude[0], -np.inf, model.view_counts)
+            order = np.argsort(-counts)[: query.num]
+            pairs = [(float(counts[i]), int(i)) for i in order
+                     if np.isfinite(counts[i])]
+        return PredictedResult(
+            itemScores=[ItemScore(item=inv[i], score=s) for s, i in pairs])
+
+
+def engine() -> Engine:
+    """Reference: ECommerceRecommendationEngine EngineFactory."""
+    return Engine(
+        datasource_class=ECommerceDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_classes={"ecomm": ECommAlgorithm},
+        serving_class=FirstServing,
+        query_class=Query,
+    )

@@ -1,0 +1,23 @@
+from predictionio_tpu.templates.similarproduct.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    SimilarProductDataSource,
+    ViewData,
+    engine,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "SimilarProductDataSource",
+    "ViewData",
+    "engine",
+]

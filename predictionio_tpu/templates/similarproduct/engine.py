@@ -1,0 +1,202 @@
+"""Similar-product template — "people who viewed X also viewed".
+
+Reference: examples/scala-parallel-similarproduct (SURVEY.md §2.2):
+implicit ALS on view events; at query time the candidate items are scored
+by **cosine similarity of item factors** against the query items' factors
+(summed over multiple query items), with category/white/black-list
+filtering.  Contract preserved:
+
+- events: ``view`` (user→item); ``$set`` "item" entities carry
+  ``categories`` (list of strings)
+- query JSON: ``{"items": ["i1"], "num": 4, "categories"?: [...],
+  "whiteList"?: [...], "blackList"?: [...]}``
+- result JSON: ``{"itemScores": [{"item": ..., "score": ...}]}``
+
+Substrate: the pairwise-cosine top-K is one normalized matmul on the MXU
+(reference: blocked ``productFeatures`` cosine loop, §2.2 table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.data.event import BiMap
+from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.ops.topk import top_k_scores
+
+__all__ = [
+    "Query", "ItemScore", "PredictedResult", "ViewData", "DataSourceParams",
+    "SimilarProductDataSource", "ALSAlgorithmParams", "ALSAlgorithm", "engine",
+]
+
+
+@dataclasses.dataclass
+class Query:
+    items: List[str]
+    num: int = 10
+    categories: Optional[List[str]] = None
+    whiteList: Optional[List[str]] = None  # noqa: N815 — reference JSON keys
+    blackList: Optional[List[str]] = None  # noqa: N815
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: List[ItemScore]  # noqa: N815
+
+
+@dataclasses.dataclass
+class ViewData:
+    user_ids: np.ndarray
+    item_ids: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+    item_categories: Dict[str, Set[str]]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815
+    eventNames: Sequence[str] = ("view",)  # noqa: N815
+
+
+class SimilarProductDataSource(DataSource):
+    """Reference: DataSource.scala — view events + item $set categories."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> ViewData:
+        p: DataSourceParams = self.params
+        table = ctx.event_store.find_columnar(
+            p.appName, entity_type="user", target_entity_type="item",
+            event_names=list(p.eventNames))
+        users = table.column("entity_id").to_pylist()
+        items = table.column("target_entity_id").to_pylist()
+        props = ctx.event_store.aggregate_properties(p.appName, "item")
+        cats: Dict[str, Set[str]] = {}
+        for item, pm in props.items():
+            c = pm.get("categories")
+            if c:
+                cats[item] = set(c)
+        user_index = BiMap.string_int(users)
+        item_index = BiMap.string_int(items)
+        return ViewData(
+            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
+            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
+            user_index=user_index,
+            item_index=item_index,
+            item_categories=cats,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 10  # noqa: N815
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    item_factors: np.ndarray       # [I, K] L2-normalized
+    item_index: BiMap
+    item_categories: Dict[str, Set[str]]
+
+
+class ALSAlgorithm(Algorithm):
+    """Implicit ALS; keeps only normalized item factors (reference parity —
+    the similarproduct ALSModel stores productFeatures only)."""
+
+    params_class = ALSAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: ViewData) -> SimilarProductModel:
+        p: ALSAlgorithmParams = self.params
+        if len(prepared_data.user_ids) == 0:
+            raise ValueError("No view events found — check appName/eventNames.")
+        cfg = als_lib.ALSConfig(
+            rank=p.rank, iterations=p.numIterations, reg=p.lambda_,
+            alpha=p.alpha, implicit=True,
+            seed=p.seed if p.seed is not None else ctx.seed)
+        model = als_lib.train_als(
+            prepared_data.user_ids, prepared_data.item_ids, None,
+            n_users=len(prepared_data.user_index),
+            n_items=len(prepared_data.item_index),
+            config=cfg, mesh=ctx.mesh)
+        f = np.asarray(model.item_factors)
+        norms = np.linalg.norm(f, axis=1, keepdims=True)
+        f = f / np.where(norms < 1e-9, 1.0, norms)
+        return SimilarProductModel(
+            item_factors=f,
+            item_index=prepared_data.item_index,
+            item_categories=prepared_data.item_categories,
+        )
+
+    def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
+        known = [model.item_index[i] for i in query.items
+                 if i in model.item_index]
+        if not known:
+            return PredictedResult(itemScores=[])
+        f = jnp.asarray(model.item_factors)
+        q = f[jnp.asarray(known)].sum(axis=0, keepdims=True)  # [1, K]
+
+        n_items = f.shape[0]
+        exclude = np.zeros((1, n_items), dtype=bool)
+        exclude[0, known] = True  # never return the query items themselves
+        inv = model.item_index.inverse
+        if query.categories is not None:
+            want = set(query.categories)
+            for idx in range(n_items):
+                cats = model.item_categories.get(inv[idx], set())
+                if not (cats & want):
+                    exclude[0, idx] = True
+        if query.whiteList is not None:
+            allowed = {model.item_index[i] for i in query.whiteList
+                       if i in model.item_index}
+            for idx in range(n_items):
+                if idx not in allowed:
+                    exclude[0, idx] = True
+        if query.blackList:
+            for i in query.blackList:
+                if i in model.item_index:
+                    exclude[0, model.item_index[i]] = True
+
+        k = min(query.num, n_items)
+        scores, ids = top_k_scores(q, f, k, exclude=jnp.asarray(exclude))
+        out = []
+        for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0])):
+            if s <= -1e37:  # ran out of unmasked candidates
+                break
+            out.append(ItemScore(item=inv[int(i)], score=float(s)))
+        return PredictedResult(itemScores=out)
+
+
+def engine() -> Engine:
+    """Reference: SimilarProductEngine EngineFactory."""
+    return Engine(
+        datasource_class=SimilarProductDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_class=FirstServing,
+        query_class=Query,
+    )

@@ -1,0 +1,88 @@
+"""E-commerce template: ALS + live business rules at serve time."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.templates.ecommerce import Query, engine
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _seed(ctx, n_users=20, n_items=10, seed=0):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(seed)
+    ev = storage.get_events()
+    for u in range(n_users):
+        pool = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(pool, size=4, replace=True):
+            ev.insert(Event(event="view", entity_type="user", entity_id=f"u{u}",
+                            target_entity_type="item", target_entity_id=f"i{i}"),
+                      app_id)
+    return app_id
+
+
+VARIANT = {
+    "engineFactory": "predictionio_tpu.templates.ecommerce:engine",
+    "datasource": {"params": {"appName": "testapp"}},
+    "algorithms": [{"name": "ecomm",
+                    "params": {"appName": "testapp", "rank": 8,
+                               "numIterations": 8, "alpha": 10.0, "seed": 5}}],
+}
+
+
+def _trained(ctx):
+    eng = engine()
+    variant = EngineVariant.from_dict(VARIANT)
+    iid = run_train(eng, variant, ctx)
+    inst = ctx.storage.get_engine_instances().get(iid)
+    models = load_models(eng, inst, ctx)
+    algo = eng.make_algorithms(eng.bind_engine_params(VARIANT))[0]
+    return algo, models[0]
+
+
+def test_seen_items_excluded(ctx):
+    app_id = _seed(ctx)
+    algo, model = _trained(ctx)
+    seen = {e.target_entity_id
+            for e in ctx.storage.get_events().find(
+                app_id, entity_id="u0", entity_type="user")}
+    res = algo.predict(model, Query(user="u0", num=10))
+    assert res.itemScores
+    assert not seen & {s.item for s in res.itemScores}
+
+
+def test_unavailable_items_excluded(ctx):
+    app_id = _seed(ctx)
+    ctx.storage.get_events().insert(
+        Event(event="$set", entity_type="constraint",
+              entity_id="unavailableItems",
+              properties=DataMap({"items": ["i2", "i4"]})), app_id)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(user="u0", num=10))
+    assert not {"i2", "i4"} & {s.item for s in res.itemScores}
+
+
+def test_unknown_user_popularity_fallback(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(user="ghost", num=3))
+    assert len(res.itemScores) == 3
+    # Fallback scores are view counts — descending.
+    scores = [s.score for s in res.itemScores]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_blacklist(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(user="u1", num=10, blackList=["i1"]))
+    assert "i1" not in [s.item for s in res.itemScores]

@@ -1,0 +1,96 @@
+"""Similar-product template: view events → item-factor cosine retrieval."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.templates.similarproduct import Query, engine
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _seed(ctx, n_users=24, n_items=12, seed=0):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(seed)
+    ev = storage.get_events()
+    # Co-view structure: even users view even items, odd view odd.
+    for u in range(n_users):
+        pool = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(pool, size=5, replace=True):
+            ev.insert(Event(event="view", entity_type="user", entity_id=f"u{u}",
+                            target_entity_type="item", target_entity_id=f"i{i}"),
+                      app_id)
+    for i in range(n_items):
+        ev.insert(Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                        properties=DataMap(
+                            {"categories": ["even" if i % 2 == 0 else "odd"]})),
+                  app_id)
+    return app_id
+
+
+VARIANT = {
+    "engineFactory": "predictionio_tpu.templates.similarproduct:engine",
+    "datasource": {"params": {"appName": "testapp"}},
+    "algorithms": [{"name": "als",
+                    "params": {"rank": 8, "numIterations": 10, "alpha": 10.0,
+                               "seed": 5}}],
+}
+
+
+def _trained(ctx):
+    eng = engine()
+    variant = EngineVariant.from_dict(VARIANT)
+    iid = run_train(eng, variant, ctx)
+    inst = ctx.storage.get_engine_instances().get(iid)
+    models = load_models(eng, inst, ctx)
+    algo = eng.make_algorithms(eng.bind_engine_params(VARIANT))[0]
+    return algo, models[0]
+
+
+def test_similar_items_share_clique(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(items=["i0"], num=4))
+    assert len(res.itemScores) == 4
+    assert "i0" not in [s.item for s in res.itemScores]
+    even = sum(1 for s in res.itemScores if int(s.item[1:]) % 2 == 0)
+    assert even >= 3
+
+
+def test_category_filter(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(items=["i0"], num=4, categories=["odd"]))
+    assert res.itemScores
+    assert all(int(s.item[1:]) % 2 == 1 for s in res.itemScores)
+
+
+def test_white_black_lists(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(items=["i0"], num=4, whiteList=["i2", "i4"]))
+    assert {s.item for s in res.itemScores} <= {"i2", "i4"}
+    res = algo.predict(model, Query(items=["i0"], num=11, blackList=["i2"]))
+    assert "i2" not in [s.item for s in res.itemScores]
+
+
+def test_unknown_item_empty(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    assert algo.predict(model, Query(items=["ghost"])).itemScores == []
+
+
+def test_multi_item_query(ctx):
+    _seed(ctx)
+    algo, model = _trained(ctx)
+    res = algo.predict(model, Query(items=["i0", "i2"], num=3))
+    assert len(res.itemScores) == 3
+    assert not {"i0", "i2"} & {s.item for s in res.itemScores}

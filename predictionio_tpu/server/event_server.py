@@ -245,19 +245,21 @@ class EventServer:
                 else:
                     data = json.dumps(payload).encode()
                     ctype = "application/json; charset=UTF-8"
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
                 name = None
                 if method == "POST" and parsed.path == "/events.json" and status == 201:
                     try:
                         name = json.loads(body).get("event")
                     except Exception:
                         name = None
+                # Record BEFORE replying: a client reading /stats.json right
+                # after its POST completes must see its own event counted.
                 server_self.stats.record(status, name,
                                          (time.perf_counter() - t0) * 1e3)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")

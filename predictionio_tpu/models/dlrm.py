@@ -1,0 +1,276 @@
+"""DLRM-style CTR ranking — sharded embedding tables + dense interaction.
+
+Absent in the reference (SURVEY.md §2.2: new build target from BASELINE
+config 5).  This is the EP-shaped component of the build (§2.4): the
+categorical embedding tables dominate memory, so they are **row-sharded
+over the ``expert`` mesh axis**, and lookups are exchanged with XLA
+collectives over ICI.
+
+Lookup design (``sharded_embedding_lookup``): all feature tables are
+concatenated into one [ΣV, E] table, row-sharded.  Inside ``shard_map``:
+
+1. every shard all-gathers the (tiny, int32) global index batch,
+2. computes masked partial embeddings for the indices it owns
+   (``idx ∈ [lo, hi)`` → ``table[idx - lo]``, else 0), and
+3. ``psum_scatter`` returns each batch-shard its summed rows — exactly one
+   owner contributes per index, so the sum IS the lookup.
+
+Traffic: an all-gather of int32 indices + one reduce-scatter of the
+embedding activations — both nearest-neighbor ICI patterns.  (The
+request/reply ``all_to_all`` variant saves bandwidth at large expert
+counts; this formulation is MXU-friendlier and exact.)
+
+Model: bottom MLP over dense features, pairwise dot-product feature
+interaction (the DLRM arch), top MLP → CTR logit.  bf16 matmuls, f32
+master weights, optax adagrad (the DLRM-paper optimizer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import AXIS_EXPERT
+
+__all__ = ["DLRMConfig", "DLRMState", "init_state", "train_step", "train",
+           "predict_proba", "sharded_embedding_lookup"]
+
+
+@dataclasses.dataclass
+class DLRMConfig:
+    vocab_sizes: Tuple[int, ...]        # per categorical feature field
+    n_dense: int                        # dense feature count
+    embed_dim: int = 16
+    bottom_mlp: Tuple[int, ...] = (64, 32)
+    top_mlp: Tuple[int, ...] = (64, 32)
+    learning_rate: float = 0.05
+    batch_size: int = 512
+    epochs: int = 2
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.bottom_mlp[-1] != self.embed_dim:
+            raise ValueError(
+                f"bottom_mlp[-1] ({self.bottom_mlp[-1]}) must equal embed_dim "
+                f"({self.embed_dim}) — the dot interaction mixes them.")
+
+    @property
+    def total_vocab(self) -> int:
+        return int(sum(self.vocab_sizes))
+
+    @property
+    def offsets(self) -> np.ndarray:
+        """Row offset of each field's table in the concatenated table."""
+        return np.cumsum([0, *self.vocab_sizes[:-1]]).astype(np.int32)
+
+
+def _init_mlp(key, in_dim: int, dims: Sequence[int]) -> List[Dict]:
+    layers = []
+    all_dims = (in_dim, *dims)
+    for a, b in zip(all_dims[:-1], all_dims[1:]):
+        key, k = jax.random.split(key)
+        layers.append({
+            "w": jax.random.normal(k, (a, b), jnp.float32) * (2.0 / a) ** 0.5,
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return layers
+
+
+def _mlp(layers: List[Dict], x: jax.Array, final_relu: bool = True) -> jax.Array:
+    h = x
+    for i, layer in enumerate(layers):
+        h = jnp.einsum("bd,dh->bh", h.astype(jnp.bfloat16),
+                       layer["w"].astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32) + layer["b"]
+        if final_relu or i < len(layers) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def init_params(cfg: DLRMConfig) -> Dict:
+    key = jax.random.PRNGKey(cfg.seed)
+    ke, kb, kt = jax.random.split(key, 3)
+    n_fields = len(cfg.vocab_sizes)
+    # Interaction: pairwise dots among (n_fields + 1) vectors (emb + bottom).
+    n_vec = n_fields + 1
+    inter_dim = n_vec * (n_vec - 1) // 2 + cfg.bottom_mlp[-1]
+    return {
+        "embed": jax.random.normal(ke, (cfg.total_vocab, cfg.embed_dim),
+                                   jnp.float32) * (cfg.embed_dim ** -0.5),
+        "bottom": _init_mlp(kb, cfg.n_dense, (*cfg.bottom_mlp[:-1],
+                                              cfg.bottom_mlp[-1])),
+        "top": _init_mlp(kt, inter_dim, (*cfg.top_mlp, 1)),
+    }
+
+
+def param_shardings(cfg: DLRMConfig, mesh: Mesh):
+    rep = NamedSharding(mesh, P())
+    return {
+        "embed": NamedSharding(mesh, P(AXIS_EXPERT, None)),
+        "bottom": [jax.tree.map(lambda _: rep, l)
+                   for l in init_params(cfg)["bottom"]],
+        "top": [jax.tree.map(lambda _: rep, l)
+                for l in init_params(cfg)["top"]],
+    }
+
+
+# -- the EP lookup ----------------------------------------------------------
+
+def sharded_embedding_lookup(
+    mesh: Mesh,
+    table: jax.Array,     # [V, E] row-sharded over AXIS_EXPERT
+    indices: jax.Array,   # [B, F] int32 global rows, batch-sharded over AXIS_EXPERT
+) -> jax.Array:           # [B, F, E] batch-sharded
+    """Row-sharded table lookup via all_gather(idx) + psum_scatter(rows)."""
+    n_shards = mesh.shape[AXIS_EXPERT]
+    v = table.shape[0]
+    assert v % n_shards == 0, f"pad vocab ({v}) to a multiple of {n_shards}"
+    rows_per = v // n_shards
+
+    def local(tab, idx):  # tab: [V/S, E]; idx: [B/S, F]
+        shard = jax.lax.axis_index(AXIS_EXPERT)
+        idx_all = jax.lax.all_gather(idx, AXIS_EXPERT, axis=0,
+                                     tiled=True)          # [B, F]
+        rel = idx_all - shard * rows_per
+        mine = (rel >= 0) & (rel < rows_per)
+        safe = jnp.clip(rel, 0, rows_per - 1)
+        part = jnp.where(mine[..., None], tab[safe], 0.0)  # [B, F, E]
+        return jax.lax.psum_scatter(part, AXIS_EXPERT, scatter_dimension=0,
+                                    tiled=True)            # [B/S, F, E]
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXIS_EXPERT, None), P(AXIS_EXPERT, None)),
+        out_specs=P(AXIS_EXPERT, None, None),
+    )(table, indices)
+
+
+def _interact(emb: jax.Array, bottom_out: jax.Array) -> jax.Array:
+    """DLRM pairwise-dot interaction: [B,F,E] x [B,E] → [B, F+1 choose 2 + D]."""
+    vecs = jnp.concatenate([emb, bottom_out[:, None, :]], axis=1)  # [B,F+1,E]
+    prods = jnp.einsum("bfe,bge->bfg", vecs, vecs,
+                       preferred_element_type=jnp.float32)
+    n = vecs.shape[1]
+    iu, ju = jnp.triu_indices(n, k=1)
+    flat = prods[:, iu, ju]                                        # [B, nC2]
+    return jnp.concatenate([flat, bottom_out], axis=1)
+
+
+def _forward(params: Dict, dense: jax.Array, cat: jax.Array,
+             mesh: Optional[Mesh]) -> jax.Array:
+    if mesh is not None and mesh.shape.get(AXIS_EXPERT, 1) > 1:
+        emb = sharded_embedding_lookup(mesh, params["embed"], cat)
+    else:
+        emb = params["embed"][cat]                                 # [B, F, E]
+    bottom_out = _mlp(params["bottom"], dense)                     # [B, D]
+    z = _interact(emb, bottom_out)
+    logit = _mlp(params["top"], z, final_relu=False)               # [B, 1]
+    return logit[:, 0]
+
+
+def _loss(params, dense, cat, labels, weights, mesh):
+    logits = _forward(params, dense, cat, mesh)
+    losses = optax.sigmoid_binary_cross_entropy(logits, labels)
+    return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+@dataclasses.dataclass
+class DLRMState:
+    params: Dict
+    opt_state: Any
+    step: jax.Array
+
+
+def _tx(cfg: DLRMConfig):
+    return optax.adagrad(cfg.learning_rate)
+
+
+def init_state(cfg: DLRMConfig, mesh: Optional[Mesh] = None) -> DLRMState:
+    params = init_params(cfg)
+    if mesh is not None:
+        params = jax.device_put(params, param_shardings(cfg, mesh))
+    return DLRMState(params=params, opt_state=_tx(cfg).init(params),
+                     step=jnp.zeros((), jnp.int32))
+
+
+class _StepKey:
+    """Static-arg wrapper for (cfg, mesh) — hashed by compile-relevant bits."""
+
+    def __init__(self, cfg: DLRMConfig, mesh: Optional[Mesh]):
+        self.cfg = cfg
+        self.mesh = mesh
+        self._key = (cfg.learning_rate, cfg.vocab_sizes, cfg.embed_dim,
+                     cfg.bottom_mlp, cfg.top_mlp,
+                     tuple(sorted(mesh.shape.items())) if mesh else None)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _StepKey) and self._key == other._key
+
+
+@functools.partial(jax.jit, static_argnames=("key",), donate_argnums=(0,))
+def _train_step_impl(state_tuple, dense, cat, labels, weights, key: _StepKey):
+    params, opt_state, step = state_tuple
+    loss, grads = jax.value_and_grad(_loss)(params, dense, cat, labels,
+                                            weights, key.mesh)
+    updates, opt_state = _tx(key.cfg).update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return (params, opt_state, step + 1), loss
+
+
+def train_step(state: DLRMState, dense, cat, labels, weights,
+               cfg: DLRMConfig, mesh: Optional[Mesh] = None):
+    (p, o, s), loss = _train_step_impl(
+        (state.params, state.opt_state, state.step),
+        dense, cat, labels, weights, _StepKey(cfg, mesh))
+    return DLRMState(params=p, opt_state=o, step=s), loss
+
+
+def train(
+    dense: np.ndarray,      # [N, n_dense] float
+    cat: np.ndarray,        # [N, F] int — PER-FIELD indices (offsets applied here)
+    labels: np.ndarray,     # [N] {0,1}
+    cfg: DLRMConfig,
+    mesh: Optional[Mesh] = None,
+) -> DLRMState:
+    n = len(labels)
+    cat_global = (np.asarray(cat, np.int64) + cfg.offsets[None, :]).astype(np.int32)
+    rng = np.random.default_rng(cfg.seed)
+    state = init_state(cfg, mesh)
+    bs = cfg.batch_size
+    sh = NamedSharding(mesh, P(AXIS_EXPERT)) if mesh is not None else None
+    for _ in range(cfg.epochs):
+        order = rng.permutation(n)
+        for start in range(0, n, bs):
+            sel = order[start:start + bs]
+            pad = bs - len(sel)
+            d = np.concatenate([dense[sel],
+                                np.zeros((pad, cfg.n_dense), np.float32)])
+            c = np.concatenate([cat_global[sel],
+                                np.zeros((pad, cat.shape[1]), np.int32)])
+            y = np.concatenate([labels[sel], np.zeros(pad, np.float32)])
+            w = np.concatenate([np.ones(len(sel), np.float32),
+                                np.zeros(pad, np.float32)])
+            args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
+                    jnp.asarray(y, jnp.float32), jnp.asarray(w)]
+            if sh is not None:
+                args = [jax.device_put(a, sh) for a in args]
+            state, _ = train_step(state, *args, cfg, mesh)
+    return state
+
+
+def predict_proba(state: DLRMState, dense: np.ndarray, cat: np.ndarray,
+                  cfg: DLRMConfig, mesh: Optional[Mesh] = None) -> jax.Array:
+    cat_global = (np.asarray(cat, np.int64) + cfg.offsets[None, :]).astype(np.int32)
+    logits = _forward(state.params, jnp.asarray(dense, jnp.float32),
+                      jnp.asarray(cat_global), mesh)
+    return jax.nn.sigmoid(logits)

@@ -1,0 +1,21 @@
+from predictionio_tpu.templates.dlrm.engine import (
+    CTRData,
+    DataSourceParams,
+    DLRMAlgorithm,
+    DLRMAlgorithmParams,
+    DLRMDataSource,
+    PredictedResult,
+    Query,
+    engine,
+)
+
+__all__ = [
+    "CTRData",
+    "DataSourceParams",
+    "DLRMAlgorithm",
+    "DLRMAlgorithmParams",
+    "DLRMDataSource",
+    "PredictedResult",
+    "Query",
+    "engine",
+]

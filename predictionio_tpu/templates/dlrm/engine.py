@@ -1,0 +1,188 @@
+"""DLRM / Wide&Deep CTR ranking template.
+
+TPU-era engine (BASELINE config 5; absent in the reference — SURVEY.md
+§2.2).  Event contract:
+
+- impression events (default ``impression``): user→item with a ``clicked``
+  property (bool/0/1), optional ``dense`` list property (numeric context
+  features, e.g. position, hour)
+- query JSON: ``{"user": "u1", "items": ["i1","i2"], "dense"?: [...]}``
+  → result ``{"itemScores": [{"item", "score"}]}`` — scores are predicted
+  CTRs, items ranked by them
+
+Categorical fields: (user id, item id) hashed into fixed vocabularies —
+unseen entities at serve time degrade gracefully to shared hash buckets.
+Substrate: :mod:`models.dlrm` with expert-sharded embedding tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.models import dlrm as dlrm_lib
+
+__all__ = [
+    "Query", "ItemScore", "PredictedResult", "CTRData", "DataSourceParams",
+    "DLRMDataSource", "DLRMAlgorithmParams", "DLRMAlgorithm", "engine",
+]
+
+
+def _hash(s: str, mod: int) -> int:
+    """Stable string→bucket hash (zlib.crc32 is deterministic cross-run)."""
+    return zlib.crc32(s.encode()) % mod
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    items: List[str]
+    dense: Optional[List[float]] = None
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: List[ItemScore]  # noqa: N815
+
+
+@dataclasses.dataclass
+class CTRData:
+    dense: np.ndarray    # [N, n_dense]
+    cat: np.ndarray      # [N, 2] — hashed (user, item)
+    labels: np.ndarray   # [N]
+    n_dense: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815
+    eventNames: Sequence[str] = ("impression",)  # noqa: N815
+    labelProperty: str = "clicked"  # noqa: N815
+    denseProperty: str = "dense"  # noqa: N815
+    nDense: int = 4  # noqa: N815 — fixed width; shorter lists zero-padded
+    userVocab: int = 65536  # noqa: N815
+    itemVocab: int = 65536  # noqa: N815
+
+
+class DLRMDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> CTRData:
+        p: DataSourceParams = self.params
+        table = ctx.event_store.find_columnar(
+            p.appName, entity_type="user", target_entity_type="item",
+            event_names=list(p.eventNames))
+        users = table.column("entity_id").to_pylist()
+        items = table.column("target_entity_id").to_pylist()
+        props = table.column("properties_json").to_pylist()
+        if not users:
+            raise ValueError("No impression events found — check appName.")
+        dense_rows, labels = [], []
+        for pr in props:
+            obj = json.loads(pr or "{}")
+            labels.append(1.0 if obj.get(p.labelProperty) in (True, 1, 1.0) else 0.0)
+            d = list(obj.get(p.denseProperty) or [])[: p.nDense]
+            d += [0.0] * (p.nDense - len(d))
+            dense_rows.append(d)
+        cat = np.stack([
+            np.array([_hash(u, p.userVocab) for u in users], np.int64),
+            np.array([_hash(i, p.itemVocab) for i in items], np.int64),
+        ], axis=1)
+        return CTRData(
+            dense=np.asarray(dense_rows, np.float32),
+            cat=cat,
+            labels=np.asarray(labels, np.float32),
+            n_dense=p.nDense,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMAlgorithmParams(Params):
+    embedDim: int = 16  # noqa: N815
+    bottomMlp: Sequence[int] = (32, 16)  # noqa: N815
+    topMlp: Sequence[int] = (32,)  # noqa: N815
+    learningRate: float = 0.05  # noqa: N815
+    batchSize: int = 512  # noqa: N815
+    epochs: int = 3
+    userVocab: int = 65536  # noqa: N815 — must match the datasource
+    itemVocab: int = 65536  # noqa: N815
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class DLRMModelWrapper:
+    state: dlrm_lib.DLRMState
+    cfg: dlrm_lib.DLRMConfig
+    user_vocab: int
+    item_vocab: int
+    n_dense: int
+
+
+class DLRMAlgorithm(Algorithm):
+    params_class = DLRMAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: CTRData) -> DLRMModelWrapper:
+        p: DLRMAlgorithmParams = self.params
+        cfg = dlrm_lib.DLRMConfig(
+            vocab_sizes=(p.userVocab, p.itemVocab),
+            n_dense=prepared_data.n_dense,
+            embed_dim=p.embedDim,
+            bottom_mlp=tuple(p.bottomMlp),
+            top_mlp=tuple(p.topMlp),
+            learning_rate=p.learningRate,
+            batch_size=p.batchSize,
+            epochs=p.epochs,
+            seed=p.seed if p.seed is not None else ctx.seed,
+        )
+        state = dlrm_lib.train(prepared_data.dense, prepared_data.cat,
+                               prepared_data.labels, cfg, mesh=ctx.mesh)
+        return DLRMModelWrapper(state=state, cfg=cfg, user_vocab=p.userVocab,
+                                item_vocab=p.itemVocab,
+                                n_dense=prepared_data.n_dense)
+
+    def predict(self, model: DLRMModelWrapper, query: Query) -> PredictedResult:
+        if not query.items:
+            return PredictedResult(itemScores=[])
+        n = len(query.items)
+        d = list(query.dense or [])[: model.n_dense]
+        d += [0.0] * (model.n_dense - len(d))
+        dense = np.tile(np.asarray(d, np.float32)[None, :], (n, 1))
+        cat = np.stack([
+            np.full(n, _hash(query.user, model.user_vocab), np.int64),
+            np.array([_hash(i, model.item_vocab) for i in query.items], np.int64),
+        ], axis=1)
+        proba = np.asarray(
+            dlrm_lib.predict_proba(model.state, dense, cat, model.cfg))
+        order = np.argsort(-proba)
+        return PredictedResult(itemScores=[
+            ItemScore(item=query.items[int(i)], score=float(proba[i]))
+            for i in order])
+
+
+def engine() -> Engine:
+    return Engine(
+        datasource_class=DLRMDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_classes={"dlrm": DLRMAlgorithm},
+        serving_class=FirstServing,
+        query_class=Query,
+    )

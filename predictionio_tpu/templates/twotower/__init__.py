@@ -1,0 +1,23 @@
+from predictionio_tpu.templates.twotower.engine import (
+    DataSourceParams,
+    InteractionData,
+    ItemScore,
+    PredictedResult,
+    Query,
+    TwoTowerAlgorithm,
+    TwoTowerAlgorithmParams,
+    TwoTowerDataSource,
+    engine,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "InteractionData",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "TwoTowerAlgorithm",
+    "TwoTowerAlgorithmParams",
+    "TwoTowerDataSource",
+    "engine",
+]

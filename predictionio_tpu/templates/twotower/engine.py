@@ -1,0 +1,166 @@
+"""Two-tower retrieval template — neural personal recommendations.
+
+TPU-era engine (BASELINE config 4; absent in the reference — SURVEY.md
+§2.2).  Same external contract as the recommendation template so clients
+can switch engines without changing queries:
+
+- events: any positive-interaction names (default view/buy/rate)
+- query JSON: ``{"user": "u1", "num": 4}``
+- result JSON: ``{"itemScores": [{"item", "score"}]}``
+
+Substrate: :mod:`models.two_tower` — in-batch sampled-softmax training,
+DP over the ``data`` mesh axis, MIPS top-K serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    RuntimeContext,
+)
+from predictionio_tpu.controller.params import Params
+from predictionio_tpu.data.event import BiMap
+from predictionio_tpu.models import two_tower as tt_lib
+from predictionio_tpu.ops.topk import top_k_scores
+
+__all__ = [
+    "Query", "ItemScore", "PredictedResult", "InteractionData",
+    "DataSourceParams", "TwoTowerDataSource", "TwoTowerAlgorithmParams",
+    "TwoTowerAlgorithm", "engine",
+]
+
+
+@dataclasses.dataclass
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass
+class PredictedResult:
+    itemScores: List[ItemScore]  # noqa: N815
+
+
+@dataclasses.dataclass
+class InteractionData:
+    user_ids: np.ndarray
+    item_ids: np.ndarray
+    user_index: BiMap
+    item_index: BiMap
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    appName: str  # noqa: N815
+    eventNames: Sequence[str] = ("view", "buy", "rate")  # noqa: N815
+
+
+class TwoTowerDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx: RuntimeContext) -> InteractionData:
+        p: DataSourceParams = self.params
+        table = ctx.event_store.find_columnar(
+            p.appName, entity_type="user", target_entity_type="item",
+            event_names=list(p.eventNames))
+        users = table.column("entity_id").to_pylist()
+        items = table.column("target_entity_id").to_pylist()
+        user_index = BiMap.string_int(users)
+        item_index = BiMap.string_int(items)
+        return InteractionData(
+            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
+            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
+            user_index=user_index,
+            item_index=item_index,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerAlgorithmParams(Params):
+    embedDim: int = 32  # noqa: N815
+    hiddenDims: Sequence[int] = (64,)  # noqa: N815
+    outDim: int = 32  # noqa: N815
+    learningRate: float = 1e-3  # noqa: N815
+    temperature: float = 0.05
+    batchSize: int = 512  # noqa: N815
+    epochs: int = 5
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TwoTowerModelWrapper:
+    """Precomputed encoded item corpus + user embeddings for serving."""
+
+    user_vecs: np.ndarray   # [U, D] — encoded user representations
+    item_vecs: np.ndarray   # [I, D]
+    user_index: BiMap
+    item_index: BiMap
+
+
+class TwoTowerAlgorithm(Algorithm):
+    params_class = TwoTowerAlgorithmParams
+
+    def train(self, ctx: RuntimeContext, prepared_data: InteractionData) -> TwoTowerModelWrapper:
+        p: TwoTowerAlgorithmParams = self.params
+        if len(prepared_data.user_ids) == 0:
+            raise ValueError("No interaction events found — check appName.")
+        cfg = tt_lib.TwoTowerConfig(
+            n_users=len(prepared_data.user_index),
+            n_items=len(prepared_data.item_index),
+            embed_dim=p.embedDim,
+            hidden_dims=tuple(p.hiddenDims),
+            out_dim=p.outDim,
+            learning_rate=p.learningRate,
+            temperature=p.temperature,
+            batch_size=p.batchSize,
+            epochs=p.epochs,
+            seed=p.seed if p.seed is not None else ctx.seed,
+        )
+        state = tt_lib.train(prepared_data.user_ids, prepared_data.item_ids,
+                             cfg, mesh=ctx.mesh)
+        user_vecs = np.asarray(
+            tt_lib.encode_users(state.params, jnp.arange(cfg.n_users)))
+        item_vecs = np.asarray(
+            tt_lib.encode_items(state.params, jnp.arange(cfg.n_items)))
+        return TwoTowerModelWrapper(
+            user_vecs=user_vecs, item_vecs=item_vecs,
+            user_index=prepared_data.user_index,
+            item_index=prepared_data.item_index)
+
+    def predict(self, model: TwoTowerModelWrapper, query: Query) -> PredictedResult:
+        uidx = model.user_index.get(query.user)
+        if uidx is None:
+            return PredictedResult(itemScores=[])
+        q = jnp.asarray(model.user_vecs[uidx][None, :])
+        k = min(query.num, model.item_vecs.shape[0])
+        scores, ids = top_k_scores(q, jnp.asarray(model.item_vecs), k)
+        inv = model.item_index.inverse
+        return PredictedResult(itemScores=[
+            ItemScore(item=inv[int(i)], score=float(s))
+            for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0]))])
+
+
+def engine() -> Engine:
+    return Engine(
+        datasource_class=TwoTowerDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_classes={"twotower": TwoTowerAlgorithm},
+        serving_class=FirstServing,
+        query_class=Query,
+    )

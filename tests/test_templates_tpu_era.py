@@ -1,0 +1,112 @@
+"""TPU-era templates: two-tower retrieval + DLRM CTR ranking, end-to-end."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _seed_views(ctx, n_users=24, n_items=12, seed=0):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(seed)
+    ev = storage.get_events()
+    for u in range(n_users):
+        pool = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(pool, size=6, replace=True):
+            ev.insert(Event(event="view", entity_type="user", entity_id=f"u{u}",
+                            target_entity_type="item", target_entity_id=f"i{i}"),
+                      app_id)
+    return app_id
+
+
+class TestTwoTowerTemplate:
+    VARIANT = {
+        "engineFactory": "predictionio_tpu.templates.twotower:engine",
+        "datasource": {"params": {"appName": "testapp"}},
+        "algorithms": [{"name": "twotower",
+                        "params": {"embedDim": 16, "hiddenDims": [32],
+                                   "outDim": 16, "epochs": 30,
+                                   "learningRate": 0.003, "batchSize": 64,
+                                   "seed": 1}}],
+    }
+
+    def test_train_and_predict(self, ctx):
+        from predictionio_tpu.templates.twotower import Query, engine
+
+        _seed_views(ctx)
+        eng = engine()
+        variant = EngineVariant.from_dict(self.VARIANT)
+        iid = run_train(eng, variant, ctx)
+        inst = ctx.storage.get_engine_instances().get(iid)
+        assert inst.status == "COMPLETED"
+        models = load_models(eng, inst, ctx)
+        algo = eng.make_algorithms(eng.bind_engine_params(self.VARIANT))[0]
+        res = algo.predict(models[0], Query(user="u0", num=5))
+        assert len(res.itemScores) == 5
+        even = sum(1 for s in res.itemScores if int(s.item[1:]) % 2 == 0)
+        assert even >= 4
+        assert algo.predict(models[0], Query(user="ghost")).itemScores == []
+
+
+class TestDLRMTemplate:
+    VARIANT = {
+        "engineFactory": "predictionio_tpu.templates.dlrm:engine",
+        "datasource": {"params": {"appName": "testapp", "nDense": 2,
+                                  "userVocab": 128, "itemVocab": 64}},
+        "algorithms": [{"name": "dlrm",
+                        "params": {"embedDim": 8, "bottomMlp": [16, 8],
+                                   "topMlp": [16], "epochs": 8,
+                                   "batchSize": 128, "userVocab": 128,
+                                   "itemVocab": 64, "seed": 2}}],
+    }
+
+    def _seed_impressions(self, ctx, n=600, seed=0):
+        storage = ctx.storage
+        app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+        storage.get_events().init(app_id)
+        rng = np.random.default_rng(seed)
+        ev = storage.get_events()
+        for _ in range(n):
+            u = rng.integers(0, 20)
+            i = rng.integers(0, 10)
+            # Even items get clicked far more often.
+            p = 0.8 if i % 2 == 0 else 0.1
+            ev.insert(
+                Event(event="impression", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({
+                          "clicked": bool(rng.random() < p),
+                          "dense": [float(rng.random()), 1.0]})),
+                app_id)
+        return app_id
+
+    def test_train_and_rank(self, ctx):
+        from predictionio_tpu.templates.dlrm import Query, engine
+
+        self._seed_impressions(ctx)
+        eng = engine()
+        variant = EngineVariant.from_dict(self.VARIANT)
+        iid = run_train(eng, variant, ctx)
+        inst = ctx.storage.get_engine_instances().get(iid)
+        assert inst.status == "COMPLETED"
+        models = load_models(eng, inst, ctx)
+        algo = eng.make_algorithms(eng.bind_engine_params(self.VARIANT))[0]
+        res = algo.predict(models[0], Query(
+            user="u0", items=["i0", "i1", "i2", "i3"], dense=[0.5, 1.0]))
+        assert len(res.itemScores) == 4
+        scores = {s.item: s.score for s in res.itemScores}
+        # Clicky (even) items outrank sticky (odd) ones.
+        assert (scores["i0"] + scores["i2"]) / 2 > (scores["i1"] + scores["i3"]) / 2
+        # Ranked descending.
+        vals = [s.score for s in res.itemScores]
+        assert vals == sorted(vals, reverse=True)

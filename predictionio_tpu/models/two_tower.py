@@ -194,22 +194,41 @@ def train(
     cfg: TwoTowerConfig,
     mesh: Optional[Mesh] = None,
     weights: Optional[np.ndarray] = None,
+    *,
+    checkpoint_dir=None,
+    save_every: int = 0,
 ) -> TwoTowerState:
     """Minibatch training loop over interaction pairs.
 
     The trailing ragged batch is padded with weight-0 rows — fixed shapes,
-    one compilation (SURVEY.md §7 recompilation discipline).
+    one compilation (SURVEY.md §7 recompilation discipline).  With
+    ``checkpoint_dir`` + ``save_every``, the loop checkpoints via orbax and
+    resumes mid-epoch after a crash (per-epoch rng streams make batch
+    order reconstructible, so skipped batches are exact).
     """
+    from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
+
     n = len(user_ids)
     if weights is None:
         weights = np.ones(n, dtype=np.float32)
-    rng = np.random.default_rng(cfg.seed)
     state = init_state(cfg, mesh)
+    ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
+                             if checkpoint_dir else 0)
+    start_step = ckpt.restore_step(
+        (state.params, state.opt_state, state.step))
+    if ckpt.restored_state is not None:
+        p, o, s = ckpt.restored_state
+        state = TwoTowerState(params=p, opt_state=o, step=s)
     bs = cfg.batch_size
+    steps_per_epoch = (n + bs - 1) // bs
     batch_sharding = NamedSharding(mesh, P(AXIS_DATA)) if mesh is not None else None
-    for _ in range(cfg.epochs):
-        order = rng.permutation(n)
+    global_step = 0
+    for epoch in range(cfg.epochs):
+        order = np.random.default_rng(cfg.seed + epoch).permutation(n)
         for start in range(0, n, bs):
+            global_step += 1
+            if global_step <= start_step:
+                continue  # resume fast-forward: batch already trained
             sel = order[start:start + bs]
             pad = bs - len(sel)
             u = np.concatenate([user_ids[sel], np.zeros(pad, np.int64)])
@@ -219,6 +238,10 @@ def train(
             if batch_sharding is not None:
                 args = tuple(jax.device_put(a, batch_sharding) for a in args)
             state, _ = train_step(state, *args, cfg)
+            ckpt.maybe_save(global_step,
+                            (state.params, state.opt_state, state.step))
+    ckpt.finalize()
+    ckpt.close()
     return state
 
 

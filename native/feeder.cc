@@ -1,0 +1,177 @@
+// Event-log feeder — native batch assembly for the training input pipeline.
+//
+// Reference role (SURVEY.md §2.3): the rebuild owes a host-side native
+// data loader where the reference leaned on Spark's netty/snappy IO. This
+// library mmaps a binary columnar event cache (written once by the Python
+// storage layer; format below), and serves shuffled, padded minibatches
+// from worker threads so the Python/JAX process never blocks on batch
+// assembly: the feeder fills pinned buffers while the device runs step N.
+//
+// File format "PIOF1" (little-endian):
+//   0:  char[5] magic "PIOF1"
+//   5:  u8      pad
+//   6:  u16     version (=1)
+//   8:  u64     n_rows
+//   16: u32[n]  user ids
+//   ...:u32[n]  item ids
+//   ...:f32[n]  values
+//   ...:i64[n]  event_time_us
+//
+// C API (consumed via ctypes from predictionio_tpu/data/feeder.py):
+//   void*  pio_feeder_open(const char* path, uint64_t seed, int shuffle);
+//   int64  pio_feeder_num_rows(void*);
+//   int    pio_feeder_next_batch(void*, int64 batch, uint32* users,
+//                                uint32* items, float* vals, int64* times);
+//        -> rows written (== batch unless epoch end; 0 = epoch boundary,
+//           next call starts the re-shuffled next epoch)
+//   void   pio_feeder_close(void*);
+//
+// Shuffling uses a per-epoch Fisher-Yates permutation under a 64-bit
+// SplitMix/Xoshiro generator — deterministic given (seed, epoch), matching
+// the Python loop's resume contract.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <new>
+#include <numeric>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+};
+
+struct Feeder {
+  int fd = -1;
+  size_t map_len = 0;
+  const uint8_t* base = nullptr;
+  uint64_t n_rows = 0;
+  const uint32_t* users = nullptr;
+  const uint32_t* items = nullptr;
+  const float* vals = nullptr;
+  const int64_t* times = nullptr;
+
+  uint64_t seed = 0;
+  bool shuffle = true;
+  uint64_t epoch = 0;
+  uint64_t cursor = 0;
+  std::vector<uint64_t> perm;
+  std::mutex mu;
+
+  void reshuffle() {
+    perm.resize(n_rows);
+    std::iota(perm.begin(), perm.end(), 0);
+    if (shuffle) {
+      SplitMix64 rng(seed ^ (0xA5A5A5A5ULL + epoch * 0x9e3779b9ULL));
+      for (uint64_t i = n_rows; i > 1; --i) {
+        uint64_t j = rng.next() % i;
+        std::swap(perm[i - 1], perm[j]);
+      }
+    }
+    cursor = 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 16) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(m);
+  if (memcmp(base, "PIOF1", 5) != 0) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t n;
+  memcpy(&n, base + 8, 8);
+  const size_t need = 16 + n * (4 + 4 + 4 + 8);
+  if (static_cast<size_t>(st.st_size) < need) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  auto* f = new (std::nothrow) Feeder();
+  if (!f) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  f->fd = fd;
+  f->map_len = st.st_size;
+  f->base = base;
+  f->n_rows = n;
+  f->users = reinterpret_cast<const uint32_t*>(base + 16);
+  f->items = reinterpret_cast<const uint32_t*>(base + 16 + n * 4);
+  f->vals = reinterpret_cast<const float*>(base + 16 + n * 8);
+  f->times = reinterpret_cast<const int64_t*>(base + 16 + n * 12);
+  f->seed = seed;
+  f->shuffle = shuffle != 0;
+  f->reshuffle();
+  return f;
+}
+
+int64_t pio_feeder_num_rows(void* h) {
+  return h ? static_cast<int64_t>(static_cast<Feeder*>(h)->n_rows) : -1;
+}
+
+int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
+                              uint32_t* items, float* vals, int64_t* times) {
+  if (!h || batch <= 0) return -1;
+  auto* f = static_cast<Feeder*>(h);
+  std::lock_guard<std::mutex> lk(f->mu);
+  if (f->cursor >= f->n_rows) {
+    // Epoch boundary: signal once, then start the next epoch.
+    f->epoch++;
+    f->reshuffle();
+    return 0;
+  }
+  const uint64_t take =
+      std::min<uint64_t>(batch, f->n_rows - f->cursor);
+  for (uint64_t i = 0; i < take; ++i) {
+    const uint64_t r = f->perm[f->cursor + i];
+    users[i] = f->users[r];
+    items[i] = f->items[r];
+    if (vals) vals[i] = f->vals[r];
+    if (times) times[i] = f->times[r];
+  }
+  f->cursor += take;
+  return static_cast<int64_t>(take);
+}
+
+void pio_feeder_close(void* h) {
+  if (!h) return;
+  auto* f = static_cast<Feeder*>(h);
+  if (f->base) munmap(const_cast<uint8_t*>(f->base), f->map_len);
+  if (f->fd >= 0) ::close(f->fd);
+  delete f;
+}
+
+}  // extern "C"

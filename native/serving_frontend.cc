@@ -1,0 +1,334 @@
+// Continuous-batching serving frontend — the native `pio deploy` hot path.
+//
+// Reference role (SURVEY.md §2.3/§3.2): the reference serves /queries.json
+// from a JVM (akka-http) with per-request predict calls. XLA hates batch-1,
+// so the rebuild's native frontend owns the network path and AGGREGATES
+// in-flight requests into batches before crossing into the compiled model:
+//
+//   conn threads ──► pending queue ──► batcher thread ──► predict callback
+//        ▲                                (≤ max_batch, ≤ max_wait_us)
+//        └────────────── per-request response signal ◄─────────┘
+//
+// The predict callback is registered from Python via ctypes (CFUNCTYPE —
+// ctypes acquires the GIL on entry); it receives an opaque batch handle and
+// reads/writes requests through the pio_batch_* accessors, so no memory
+// crosses allocator boundaries.
+//
+// Endpoints: POST /queries.json (batched), GET / (status), GET /metrics
+// (Prometheus text). Everything else 404s.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Pending {
+  std::string body;
+  std::string response;
+  int status = 500;
+  bool done = false;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+using BatchCb = void (*)(void* batch_handle, int n);
+
+struct Batch {
+  std::vector<Pending*> items;
+};
+
+struct Frontend {
+  int listen_fd = -1;
+  int port = 0;
+  int max_batch = 8;
+  int max_wait_us = 2000;
+  BatchCb cb = nullptr;
+
+  std::atomic<bool> running{false};
+  std::thread acceptor;
+  std::thread batcher;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+
+  std::deque<Pending*> queue;
+  std::mutex qmu;
+  std::condition_variable qcv;
+
+  // metrics
+  std::atomic<uint64_t> n_requests{0};
+  std::atomic<uint64_t> n_errors{0};
+  std::atomic<uint64_t> n_batches{0};
+  std::atomic<uint64_t> batch_rows{0};
+};
+
+Frontend* g_frontend = nullptr;
+
+void write_all(int fd, const char* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t w = ::send(fd, data + off, len - off, MSG_NOSIGNAL);
+    if (w <= 0) return;
+    off += static_cast<size_t>(w);
+  }
+}
+
+void http_reply(int fd, int status, const char* ctype,
+                const std::string& body) {
+  const char* reason = status == 200   ? "OK"
+                       : status == 201 ? "Created"
+                       : status == 400 ? "Bad Request"
+                       : status == 404 ? "Not Found"
+                                       : "Internal Server Error";
+  char head[256];
+  int n = snprintf(head, sizeof(head),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                   status, reason, ctype, body.size());
+  write_all(fd, head, n);
+  write_all(fd, body.data(), body.size());
+}
+
+// Minimal HTTP/1.1 request reader: header block then Content-Length body.
+bool read_request(int fd, std::string& method, std::string& path,
+                  std::string& body) {
+  std::string buf;
+  char tmp[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+    if (r <= 0) return false;
+    buf.append(tmp, r);
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 20)) return false;  // header flood guard
+  }
+  const std::string head = buf.substr(0, header_end);
+  size_t sp1 = head.find(' ');
+  size_t sp2 = head.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  method = head.substr(0, sp1);
+  path = head.substr(sp1 + 1, sp2 - sp1 - 1);
+  size_t q = path.find('?');
+  if (q != std::string::npos) path.resize(q);
+
+  size_t content_length = 0;
+  size_t pos = 0;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos) eol = head.size();
+    std::string line = head.substr(pos, eol - pos);
+    for (auto& c : line)
+      if (c >= 'A' && c <= 'Z') c += 32;
+    if (line.rfind("content-length:", 0) == 0)
+      content_length = strtoul(line.c_str() + 15, nullptr, 10);
+    pos = eol + 2;
+  }
+  if (content_length > (64u << 20)) return false;  // 64 MB cap
+  body = buf.substr(header_end + 4);
+  while (body.size() < content_length) {
+    ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+    if (r <= 0) return false;
+    body.append(tmp, r);
+  }
+  body.resize(content_length);
+  return true;
+}
+
+void handle_conn(Frontend* fe, int fd) {
+  std::string method, path, body;
+  if (!read_request(fd, method, path, body)) {
+    ::close(fd);
+    return;
+  }
+  fe->n_requests++;
+  if (method == "GET" && path == "/") {
+    http_reply(fd, 200, "application/json",
+               "{\"status\":\"alive\",\"frontend\":\"native\"}");
+  } else if (method == "GET" && path == "/metrics") {
+    char m[512];
+    uint64_t nb = fe->n_batches.load(), br = fe->batch_rows.load();
+    snprintf(m, sizeof(m),
+             "# TYPE pio_frontend_requests_total counter\n"
+             "pio_frontend_requests_total %llu\n"
+             "pio_frontend_errors_total %llu\n"
+             "# TYPE pio_frontend_batch_size gauge\n"
+             "pio_frontend_batches_total %llu\n"
+             "pio_frontend_mean_batch_size %.3f\n",
+             (unsigned long long)fe->n_requests.load(),
+             (unsigned long long)fe->n_errors.load(),
+             (unsigned long long)nb, nb ? (double)br / nb : 0.0);
+    http_reply(fd, 200, "text/plain; version=0.0.4", m);
+  } else if (method == "POST" && path == "/queries.json") {
+    Pending p;
+    p.body.swap(body);
+    {
+      std::lock_guard<std::mutex> lk(fe->qmu);
+      fe->queue.push_back(&p);
+    }
+    fe->qcv.notify_one();
+    {
+      std::unique_lock<std::mutex> lk(p.mu);
+      p.cv.wait(lk, [&] { return p.done; });
+    }
+    if (p.status >= 400) fe->n_errors++;
+    http_reply(fd, p.status, "application/json; charset=UTF-8", p.response);
+  } else {
+    http_reply(fd, 404, "application/json", "{\"message\":\"Not Found\"}");
+  }
+  ::close(fd);
+}
+
+void batcher_loop(Frontend* fe) {
+  while (fe->running.load()) {
+    Batch batch;
+    {
+      std::unique_lock<std::mutex> lk(fe->qmu);
+      fe->qcv.wait_for(lk, std::chrono::milliseconds(50),
+                       [&] { return !fe->queue.empty() || !fe->running; });
+      if (!fe->running.load()) break;
+      if (fe->queue.empty()) continue;
+      // Continuous batching: take what's there, then linger briefly for
+      // stragglers up to max_batch.
+      while (!fe->queue.empty() &&
+             (int)batch.items.size() < fe->max_batch) {
+        batch.items.push_back(fe->queue.front());
+        fe->queue.pop_front();
+      }
+      if ((int)batch.items.size() < fe->max_batch && fe->max_wait_us > 0) {
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::microseconds(fe->max_wait_us);
+        while ((int)batch.items.size() < fe->max_batch &&
+               fe->qcv.wait_until(lk, deadline, [&] {
+                 return !fe->queue.empty();
+               })) {
+          while (!fe->queue.empty() &&
+                 (int)batch.items.size() < fe->max_batch) {
+            batch.items.push_back(fe->queue.front());
+            fe->queue.pop_front();
+          }
+        }
+      }
+    }
+    fe->n_batches++;
+    fe->batch_rows += batch.items.size();
+    if (fe->cb) {
+      fe->cb(&batch, (int)batch.items.size());  // → Python (GIL via ctypes)
+    }
+    for (Pending* p : batch.items) {
+      std::lock_guard<std::mutex> lk(p->mu);
+      if (!p->done) {  // callback forgot one — fail it, never hang the client
+        p->status = 500;
+        p->response = "{\"message\":\"no response produced\"}";
+        p->done = true;
+      }
+      p->cv.notify_one();
+    }
+  }
+}
+
+void acceptor_loop(Frontend* fe) {
+  while (fe->running.load()) {
+    sockaddr_in peer;
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(fe->listen_fd, (sockaddr*)&peer, &plen);
+    if (fd < 0) {
+      if (!fe->running.load()) break;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(fe->conns_mu);
+    fe->conns.emplace_back(handle_conn, fe, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int pio_frontend_start(const char* host, int port, int max_batch,
+                       int max_wait_us, BatchCb cb) {
+  if (g_frontend) return -1;
+  auto* fe = new Frontend();
+  fe->max_batch = max_batch > 0 ? max_batch : 8;
+  fe->max_wait_us = max_wait_us;
+  fe->cb = cb;
+  fe->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fe->listen_fd < 0) {
+    delete fe;
+    return -2;
+  }
+  int one = 1;
+  setsockopt(fe->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : INADDR_ANY;
+  if (bind(fe->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fe->listen_fd, 512) != 0) {
+    ::close(fe->listen_fd);
+    delete fe;
+    return -3;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fe->listen_fd, (sockaddr*)&addr, &alen);
+  fe->port = ntohs(addr.sin_port);
+  fe->running = true;
+  fe->batcher = std::thread(batcher_loop, fe);
+  fe->acceptor = std::thread(acceptor_loop, fe);
+  g_frontend = fe;
+  return fe->port;
+}
+
+int pio_frontend_port() { return g_frontend ? g_frontend->port : -1; }
+
+const char* pio_batch_request(void* batch_handle, int i, int* len_out) {
+  auto* b = static_cast<Batch*>(batch_handle);
+  if (i < 0 || i >= (int)b->items.size()) return nullptr;
+  if (len_out) *len_out = (int)b->items[i]->body.size();
+  return b->items[i]->body.c_str();
+}
+
+void pio_batch_respond(void* batch_handle, int i, const char* data, int len,
+                       int status) {
+  auto* b = static_cast<Batch*>(batch_handle);
+  if (i < 0 || i >= (int)b->items.size()) return;
+  Pending* p = b->items[i];
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->response.assign(data, len);
+  p->status = status;
+  p->done = true;
+}
+
+void pio_frontend_stop() {
+  Frontend* fe = g_frontend;
+  if (!fe) return;
+  fe->running = false;
+  ::shutdown(fe->listen_fd, SHUT_RDWR);
+  ::close(fe->listen_fd);
+  fe->qcv.notify_all();
+  if (fe->acceptor.joinable()) fe->acceptor.join();
+  if (fe->batcher.joinable()) fe->batcher.join();
+  {
+    std::lock_guard<std::mutex> lk(fe->conns_mu);
+    for (auto& t : fe->conns)
+      if (t.joinable()) t.join();
+  }
+  g_frontend = nullptr;
+  delete fe;
+}
+
+}  // extern "C"

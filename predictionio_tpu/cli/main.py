@@ -303,6 +303,24 @@ def cmd_deploy(args) -> int:
         engine, variant, _storage(), host=args.ip, port=args.port,
         instance_id=args.engine_instance_id,
     )
+    if args.native:
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(srv.query_batch, host=args.ip, port=args.port,
+                            max_batch=args.max_batch,
+                            max_wait_us=args.max_wait_us)
+        port = fe.start()
+        print(f"Native engine frontend on {args.ip}:{port} "
+              f"(instance {srv._instance.id}; continuous batching "
+              f"≤{args.max_batch}; Ctrl-C to stop)")
+        try:
+            import time as _time
+
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            fe.stop()
+        return 0
     srv.start(block=False)
     print(f"Engine Server listening on {args.ip}:{srv.port} "
           f"(instance {srv._instance.id}; Ctrl-C to stop)")
@@ -453,6 +471,10 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--ip", default="0.0.0.0")
     d.add_argument("--port", type=int, default=8000)
     d.add_argument("--engine-instance-id", dest="engine_instance_id")
+    d.add_argument("--native", action="store_true",
+                   help="serve via the C++ continuous-batching frontend")
+    d.add_argument("--max-batch", type=int, default=64)
+    d.add_argument("--max-wait-us", type=int, default=2000)
     d.set_defaults(fn=cmd_deploy)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")

@@ -1,0 +1,12 @@
+"""Native (C++) runtime components and their ctypes bindings.
+
+Reference mandate (SURVEY.md §2.3): the serving frontend and the event-log
+feeder are native, not Python stand-ins.  Sources live in ``native/`` at
+the repo root; :func:`build.load_library` compiles them on first use with
+g++ (no pybind11 in the image — plain ``extern "C"`` + ctypes) and caches
+the .so next to the sources.
+"""
+
+from predictionio_tpu.native.build import load_library, native_available
+
+__all__ = ["load_library", "native_available"]

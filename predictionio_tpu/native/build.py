@@ -1,0 +1,64 @@
+"""Compile-on-first-use loader for the C++ components.
+
+Keeps the build chain dependency-free: one ``g++ -O2 -shared`` invocation
+per translation unit, cached by source mtime.  (The reference's equivalent
+is sbt/assembly — SURVEY.md §2.1 build glue.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["load_library", "native_available", "NATIVE_DIR"]
+
+NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_cache: Dict[str, ctypes.CDLL] = {}
+_lock = threading.Lock()
+
+
+def _build(src: Path, out: Path) -> bool:
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           str(src), "-o", str(out)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"") or b""
+        logger.warning("native build failed for %s: %s", src.name,
+                       err.decode(errors="replace")[:2000])
+        return False
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Load ``native/<name>.cc`` as a shared library (build if stale)."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = NATIVE_DIR / f"{name}.cc"
+        if not src.exists():
+            logger.warning("native source %s missing", src)
+            return None
+        out = NATIVE_DIR / f"lib{name}.so"
+        if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+            if not _build(src, out):
+                return None
+        try:
+            lib = ctypes.CDLL(str(out))
+        except OSError as e:
+            logger.warning("cannot dlopen %s: %s", out, e)
+            return None
+        _cache[name] = lib
+        return lib
+
+
+def native_available(name: str) -> bool:
+    return load_library(name) is not None

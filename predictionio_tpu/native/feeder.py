@@ -1,0 +1,112 @@
+"""Python binding for the C++ event-log feeder (native/feeder.cc).
+
+Write path: :func:`write_cache` converts indexed COO interactions (the
+output of a template DataSource) into the mmap-able PIOF1 columnar cache.
+Read path: :class:`EventFeeder` iterates shuffled batches assembled by the
+native library — numpy buffers are passed straight into C (no copies on
+the C side; the arrays handed back are the reusable buffers).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from pathlib import Path
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.native.build import load_library
+
+__all__ = ["write_cache", "EventFeeder"]
+
+_MAGIC = b"PIOF1"
+
+
+def write_cache(path, user_ids, item_ids, values=None, times=None) -> Path:
+    """Write the PIOF1 binary columnar event cache."""
+    path = Path(path)
+    user_ids = np.ascontiguousarray(user_ids, dtype=np.uint32)
+    item_ids = np.ascontiguousarray(item_ids, dtype=np.uint32)
+    n = len(user_ids)
+    if values is None:
+        values = np.ones(n, dtype=np.float32)
+    if times is None:
+        times = np.zeros(n, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    times = np.ascontiguousarray(times, dtype=np.int64)
+    with open(path, "wb") as f:
+        f.write(_MAGIC + b"\x00" + struct.pack("<H", 1))
+        f.write(struct.pack("<Q", n))
+        f.write(user_ids.tobytes())
+        f.write(item_ids.tobytes())
+        f.write(values.tobytes())
+        f.write(times.tobytes())
+    return path
+
+
+class EventFeeder:
+    """Shuffled minibatch iterator over a PIOF1 cache, assembly in C++."""
+
+    def __init__(self, path, batch_size: int, *, seed: int = 0,
+                 shuffle: bool = True):
+        lib = load_library("feeder")
+        if lib is None:
+            raise RuntimeError("native feeder unavailable (g++ build failed)")
+        lib.pio_feeder_open.restype = ctypes.c_void_p
+        lib.pio_feeder_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_int]
+        lib.pio_feeder_num_rows.restype = ctypes.c_int64
+        lib.pio_feeder_num_rows.argtypes = [ctypes.c_void_p]
+        lib.pio_feeder_next_batch.restype = ctypes.c_int64
+        lib.pio_feeder_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64)]
+        lib.pio_feeder_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.pio_feeder_open(str(path).encode(), seed, int(shuffle))
+        if not self._h:
+            raise RuntimeError(f"cannot open event cache {path!r}")
+        self.batch_size = batch_size
+        self._users = np.empty(batch_size, np.uint32)
+        self._items = np.empty(batch_size, np.uint32)
+        self._vals = np.empty(batch_size, np.float32)
+        self._times = np.empty(batch_size, np.int64)
+
+    def __len__(self) -> int:
+        return int(self._lib.pio_feeder_num_rows(self._h))
+
+    def next_batch(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """One batch of (users, items, values); None at an epoch boundary."""
+        n = self._lib.pio_feeder_next_batch(
+            self._h, self.batch_size,
+            self._users.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            self._items.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if n < 0:
+            raise RuntimeError("feeder error")
+        if n == 0:
+            return None
+        n = int(n)
+        return (self._users[:n].copy(), self._items[:n].copy(),
+                self._vals[:n].copy())
+
+    def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        while True:
+            b = self.next_batch()
+            if b is None:
+                return
+            yield b
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pio_feeder_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,110 @@
+"""Python binding for the C++ continuous-batching serving frontend.
+
+Architecture (native/serving_frontend.cc): C++ owns sockets, HTTP parsing,
+and request batching; Python registers ONE callback that receives a whole
+batch and answers it through an engine's serving pipeline — typically via
+the engine's vectorized ``batch_predict`` so the XLA program runs once per
+batch instead of once per request (SURVEY.md §7 "serving latency").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import json
+import logging
+import threading
+from typing import Any, Callable, List, Optional
+
+from predictionio_tpu.native.build import load_library
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["NativeFrontend"]
+
+_BATCH_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int)
+
+
+class NativeFrontend:
+    """Wraps pio_frontend_* for a batch-handler function.
+
+    ``handler(batch: List[dict]) -> List[Any]`` maps parsed query JSONs to
+    JSON-able results, one per input (exceptions → per-item 500s).
+    """
+
+    def __init__(self, handler: Callable[[List[Any]], List[Any]],
+                 host: str = "0.0.0.0", port: int = 8000,
+                 max_batch: int = 64, max_wait_us: int = 2000):
+        lib = load_library("serving_frontend")
+        if lib is None:
+            raise RuntimeError("native frontend unavailable (g++ build failed)")
+        lib.pio_frontend_start.restype = ctypes.c_int
+        lib.pio_frontend_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            _BATCH_CB]
+        lib.pio_batch_request.restype = ctypes.c_char_p
+        lib.pio_batch_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int)]
+        lib.pio_batch_respond.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        self._lib = lib
+        self._handler = handler
+        self._host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self.max_batch = max_batch
+        self.max_wait_us = max_wait_us
+        # Keep a reference — ctypes callbacks are GC'd otherwise.
+        self._cb = _BATCH_CB(self._on_batch)
+
+    # -- callback from the C++ batcher thread ------------------------------
+
+    def _on_batch(self, batch_handle, n: int) -> None:
+        try:
+            raw: List[Optional[dict]] = []
+            for i in range(n):
+                ln = ctypes.c_int(0)
+                data = self._lib.pio_batch_request(batch_handle, i,
+                                                   ctypes.byref(ln))
+                try:
+                    raw.append(json.loads(data or b"null"))
+                except json.JSONDecodeError:
+                    raw.append(None)
+            # Malformed JSON answered inline; valid ones go to the handler.
+            valid_idx = [i for i, r in enumerate(raw) if r is not None]
+            results: List[Any] = [None] * n
+            if valid_idx:
+                try:
+                    outs = self._handler([raw[i] for i in valid_idx])
+                    for i, out in zip(valid_idx, outs):
+                        results[i] = (200, out)
+                except Exception:
+                    logger.exception("batch handler failed")
+                    for i in valid_idx:
+                        results[i] = (500, {"message": "Internal server error."})
+            for i in range(n):
+                if raw[i] is None:
+                    results[i] = (400, {"message": "Invalid JSON."})
+            for i, (status, payload) in enumerate(results):
+                body = json.dumps(payload).encode()
+                self._lib.pio_batch_respond(batch_handle, i, body, len(body),
+                                            status)
+        except Exception:
+            logger.exception("native frontend callback error")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        port = self._lib.pio_frontend_start(
+            self._host.encode(), self._requested_port, self.max_batch,
+            self.max_wait_us, self._cb)
+        if port < 0:
+            raise RuntimeError(f"pio_frontend_start failed ({port})")
+        self.port = port
+        logger.info("Native serving frontend on %s:%d (max_batch=%d)",
+                    self._host, port, self.max_batch)
+        return port
+
+    def stop(self) -> None:
+        self._lib.pio_frontend_stop()

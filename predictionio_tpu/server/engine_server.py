@@ -178,6 +178,24 @@ class EngineServer:
         predictions = [a.predict(m, q) for a, m in zip(algorithms, models)]
         return self._result_to_json(serving.serve(q, predictions))
 
+    def query_batch(self, query_jsons: List[Any]) -> List[Any]:
+        """Batched predict for the native continuous-batching frontend:
+        one ``batch_predict`` (vectorized XLA) call per algorithm instead of
+        a per-request loop."""
+        with self._swap_lock:
+            algorithms, models, serving = (
+                self._algorithms, self._models, self._serving)
+        queries = [serving.supplement(self._bind_query(qj))
+                   for qj in query_jsons]
+        indexed = list(enumerate(queries))
+        per_algo = [dict(a.batch_predict(m, indexed))
+                    for a, m in zip(algorithms, models)]
+        return [
+            self._result_to_json(
+                serving.serve(q, [pa[i] for pa in per_algo]))
+            for i, q in indexed
+        ]
+
     # -- HTTP ---------------------------------------------------------------
 
     def handle(self, method: str, path: str, body: bytes) -> Tuple[int, Any]:

@@ -1,0 +1,176 @@
+"""Native components: C++ feeder + continuous-batching frontend."""
+
+import concurrent.futures
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.native.build import native_available
+
+
+needs_native = pytest.mark.skipif(
+    not native_available("feeder") or not native_available("serving_frontend"),
+    reason="g++ build unavailable")
+
+
+@needs_native
+class TestFeeder:
+    def test_roundtrip_epoch(self, tmp_path):
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        users = np.arange(100, dtype=np.uint32)
+        items = (np.arange(100, dtype=np.uint32) * 7) % 31
+        vals = np.linspace(0, 1, 100).astype(np.float32)
+        path = write_cache(tmp_path / "events.piof", users, items, vals)
+        with EventFeeder(path, batch_size=32, seed=1) as f:
+            assert len(f) == 100
+            got_u, got_i, got_v = [], [], []
+            for u, i, v in f.epoch():
+                got_u.append(u)
+                got_i.append(i)
+                got_v.append(v)
+            all_u = np.concatenate(got_u)
+            assert len(all_u) == 100
+            # Shuffled permutation of the input, values follow their rows.
+            order = np.argsort(all_u)
+            np.testing.assert_array_equal(all_u[order], users)
+            np.testing.assert_array_equal(np.concatenate(got_i)[order], items)
+            np.testing.assert_allclose(np.concatenate(got_v)[order], vals)
+
+    def test_epochs_differ_deterministically(self, tmp_path):
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        users = np.arange(64, dtype=np.uint32)
+        path = write_cache(tmp_path / "e.piof", users, users)
+        with EventFeeder(path, batch_size=64, seed=5) as f:
+            e1 = f.next_batch()[0]
+            assert f.next_batch() is None  # epoch boundary
+            e2 = f.next_batch()[0]
+        assert not np.array_equal(e1, e2)  # re-shuffled
+        with EventFeeder(path, batch_size=64, seed=5) as f:
+            r1 = f.next_batch()[0]
+        np.testing.assert_array_equal(e1, r1)  # deterministic per seed
+
+    def test_no_shuffle_preserves_order(self, tmp_path):
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        users = np.arange(10, dtype=np.uint32)
+        path = write_cache(tmp_path / "o.piof", users, users)
+        with EventFeeder(path, batch_size=4, shuffle=False) as f:
+            u1, _, _ = f.next_batch()
+            np.testing.assert_array_equal(u1, [0, 1, 2, 3])
+
+
+@needs_native
+class TestNativeFrontend:
+    def test_batched_serving(self):
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        seen_batches = []
+
+        def handler(batch):
+            seen_batches.append(len(batch))
+            return [{"echo": q, "n": len(batch)} for q in batch]
+
+        fe = NativeFrontend(handler, host="127.0.0.1", port=0,
+                            max_batch=8, max_wait_us=20000)
+        port = fe.start()
+        try:
+            def post(i):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": f"u{i}"}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            with concurrent.futures.ThreadPoolExecutor(16) as ex:
+                results = list(ex.map(post, range(16)))
+            users = sorted(r["echo"]["user"] for r in results)
+            assert users == sorted(f"u{i}" for i in range(16))
+            # Concurrency actually produced multi-request batches.
+            assert max(r["n"] for r in results) > 1
+        finally:
+            fe.stop()
+
+    def test_serves_trained_engine(self, pio_home):
+        """Full path: trained ALS engine behind the native frontend."""
+        import numpy as np
+
+        from predictionio_tpu.controller import EngineVariant, RuntimeContext
+        from predictionio_tpu.data.event import DataMap, Event
+        from predictionio_tpu.data.storage import App, get_storage
+        from predictionio_tpu.native.frontend import NativeFrontend
+        from predictionio_tpu.server import EngineServer
+        from predictionio_tpu.templates.recommendation import engine
+        from predictionio_tpu.workflow.core_workflow import run_train
+
+        storage = get_storage()
+        ctx = RuntimeContext.create(storage=storage)
+        app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+        storage.get_events().init(app_id)
+        rng = np.random.default_rng(0)
+        for u in range(10):
+            for i in range(8):
+                if i % 2 == u % 2 and rng.random() < 0.95:
+                    storage.get_events().insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"u{u}", target_entity_type="item",
+                              target_entity_id=f"i{i}",
+                              properties=DataMap({"rating": 4.0})), app_id)
+        variant = EngineVariant.from_dict({
+            "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+            "datasource": {"params": {"appName": "testapp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "numIterations": 5}}],
+        })
+        eng = engine()
+        run_train(eng, variant, ctx)
+        srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+        fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
+                            max_batch=8, max_wait_us=10000)
+        port = fe.start()
+        try:
+            def post(u):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/queries.json",
+                    data=json.dumps({"user": u, "num": 3}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                results = list(ex.map(post, [f"u{i}" for i in range(8)]))
+            for u, res in zip(range(8), results):
+                assert len(res["itemScores"]) == 3
+                par = u % 2
+                top = [int(s["item"][1:]) % 2 for s in res["itemScores"]]
+                assert sum(1 for t in top if t == par) >= 2
+        finally:
+            fe.stop()
+
+    def test_status_metrics_and_errors(self):
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(lambda b: [{"ok": True} for _ in b],
+                            host="127.0.0.1", port=0)
+        port = fe.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/",
+                                        timeout=10) as r:
+                assert json.loads(r.read())["status"] == "alive"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json",
+                data=b"{not json", headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            assert "pio_frontend_requests_total" in text
+        finally:
+            fe.stop()

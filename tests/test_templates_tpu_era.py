@@ -35,7 +35,7 @@ class TestTwoTowerTemplate:
         "datasource": {"params": {"appName": "testapp"}},
         "algorithms": [{"name": "twotower",
                         "params": {"embedDim": 16, "hiddenDims": [32],
-                                   "outDim": 16, "epochs": 30,
+                                   "outDim": 16, "epochs": 60,
                                    "learningRate": 0.003, "batchSize": 64,
                                    "seed": 1}}],
     }

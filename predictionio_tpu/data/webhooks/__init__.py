@@ -1,0 +1,29 @@
+"""Webhook connectors — adapt third-party payloads to PIO events.
+
+Reference: data/src/main/scala/org/apache/predictionio/data/webhooks/
+(SURVEY.md §2.1): ``JsonConnector`` / ``FormConnector`` traits +
+``ConnectorUtil`` dispatch, with example connectors for segment.io
+(JSON) and mailchimp (form-encoded).  The event server mounts them at
+``POST /webhooks/<connector>.json`` (JSON) and
+``POST /webhooks/<connector>`` (form).
+"""
+
+from predictionio_tpu.data.webhooks.connectors import (
+    ConnectorError,
+    FormConnector,
+    JsonConnector,
+    MailchimpConnector,
+    SegmentIOConnector,
+    get_connector,
+    register_connector,
+)
+
+__all__ = [
+    "ConnectorError",
+    "FormConnector",
+    "JsonConnector",
+    "MailchimpConnector",
+    "SegmentIOConnector",
+    "get_connector",
+    "register_connector",
+]

@@ -212,6 +212,35 @@ class EventServer:
                 return 404, {"message": "Not Found"}
             return 200, [event_to_json(e) for e in found]
 
+        if path.startswith("/webhooks/") and method == "POST":
+            # Reference: webhooks routes (SURVEY.md §2.1) — JSON connectors
+            # at /webhooks/<name>.json, form connectors at /webhooks/<name>.
+            from urllib.parse import parse_qsl
+
+            from predictionio_tpu.data.webhooks import (
+                ConnectorError,
+                get_connector,
+            )
+
+            name = path[len("/webhooks/"):]
+            is_json = name.endswith(".json")
+            if is_json:
+                name = name[:-len(".json")]
+            try:
+                connector = get_connector(name)
+                if is_json:
+                    payload = json.loads(body.decode("utf-8"))
+                else:
+                    payload = dict(parse_qsl(body.decode("utf-8")))
+                event_json = connector.to_event_json(payload)
+                ev = event_from_json(event_json)
+            except ConnectorError as e:
+                return 400, {"message": str(e)}
+            if key_row.events and ev.event not in key_row.events:
+                return 403, {"message": f"Event {ev.event!r} not allowed by this key."}
+            event_id = events.insert(ev, key_row.app_id, channel_id)
+            return 201, {"eventId": event_id}
+
         if path.startswith("/events/") and path.endswith(".json"):
             event_id = path[len("/events/"):-len(".json")]
             if method == "GET":

@@ -1,0 +1,112 @@
+"""Webhook connectors + event-server webhook routes."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage import AccessKey, App, get_storage
+from predictionio_tpu.data.webhooks import (
+    ConnectorError,
+    MailchimpConnector,
+    SegmentIOConnector,
+    get_connector,
+)
+from predictionio_tpu.server import EventServer
+
+
+class TestSegmentIO:
+    def test_track(self):
+        out = SegmentIOConnector().to_event_json({
+            "type": "track", "userId": "u1", "event": "Item Purchased",
+            "properties": {"revenue": 39.95},
+            "timestamp": "2026-01-01T00:00:00Z"})
+        assert out["event"] == "Item Purchased"
+        assert out["entityId"] == "u1"
+        assert out["properties"]["revenue"] == 39.95
+        assert out["eventTime"].startswith("2026-01-01")
+
+    def test_identify_becomes_set(self):
+        out = SegmentIOConnector().to_event_json({
+            "type": "identify", "userId": "u2", "traits": {"plan": "pro"}})
+        assert out["event"] == "$set"
+        assert out["properties"] == {"plan": "pro"}
+
+    def test_missing_user_rejected(self):
+        with pytest.raises(ConnectorError):
+            SegmentIOConnector().to_event_json({"type": "track", "event": "x"})
+
+
+class TestMailchimp:
+    def test_subscribe(self):
+        out = MailchimpConnector().to_event_json({
+            "type": "subscribe", "fired_at": "2026-01-02 03:04:05",
+            "data[email]": "a@b.c", "data[list_id]": "L1"})
+        assert out["event"] == "subscribe"
+        assert out["entityId"] == "a@b.c"
+        assert out["properties"]["list_id"] == "L1"
+        assert out["eventTime"] == "2026-01-02T03:04:05+00:00"
+
+    def test_unknown_type(self):
+        with pytest.raises(ConnectorError):
+            MailchimpConnector().to_event_json({"type": "nope"})
+
+
+def test_registry():
+    assert get_connector("segmentio")
+    with pytest.raises(ConnectorError):
+        get_connector("missing")
+
+
+@pytest.fixture()
+def server(pio_home):
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="app1"))
+    storage.get_events().init(app_id)
+    key = storage.get_access_keys().insert(AccessKey(key="", app_id=app_id))
+    srv = EventServer(storage=storage, host="127.0.0.1", port=0)
+    srv.start()
+    yield srv, key, storage, app_id
+    srv.stop()
+
+
+def test_webhook_json_route(server):
+    srv, key, storage, app_id = server
+    payload = {"type": "track", "userId": "u9", "event": "buy",
+               "properties": {"sku": "X"}}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/webhooks/segmentio.json?accessKey={key}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+    evs = list(storage.get_events().find(app_id, entity_id="u9"))
+    assert len(evs) == 1 and evs[0].event == "buy"
+    assert evs[0].properties.get("sku") == "X"
+
+
+def test_webhook_form_route(server):
+    srv, key, storage, app_id = server
+    form = urllib.parse.urlencode({
+        "type": "subscribe", "data[email]": "a@b.c", "data[list_id]": "L1"})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/webhooks/mailchimp?accessKey={key}",
+        data=form.encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+    evs = list(storage.get_events().find(app_id, entity_id="a@b.c"))
+    assert len(evs) == 1 and evs[0].event == "subscribe"
+
+
+def test_webhook_bad_connector_404ish(server):
+    import urllib.error
+
+    srv, key, *_ = server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/webhooks/nope.json?accessKey={key}",
+        data=b"{}", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400

@@ -331,6 +331,43 @@ def cmd_deploy(args) -> int:
     return 0
 
 
+def cmd_batchpredict(args) -> int:
+    """Reference: `pio batchpredict` (0.13+) — bulk queries from NDJSON.
+
+    Uses the EngineServer's batched path so the whole file is answered in
+    vectorized XLA chunks, not per-line predicts.
+    """
+    from predictionio_tpu.controller import EngineVariant, load_engine_factory
+    from predictionio_tpu.server import EngineServer
+
+    variant_path = Path(args.engine_json)
+    if not variant_path.exists():
+        _die(f"{variant_path} not found (expected an engine.json).")
+    variant = EngineVariant.from_file(variant_path)
+    engine = load_engine_factory(variant.engine_factory)()
+    srv = EngineServer(engine, variant, _storage(),
+                       instance_id=args.engine_instance_id)
+    queries = []
+    with open(args.input) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                queries.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                _die(f"{args.input}:{line_no}: {e}")
+    n = 0
+    with open(args.output, "w") as out:
+        for start in range(0, len(queries), args.query_partitions):
+            chunk = queries[start:start + args.query_partitions]
+            for q, r in zip(chunk, srv.query_batch(chunk)):
+                out.write(json.dumps({"query": q, "prediction": r}) + "\n")
+                n += 1
+    print(f"Wrote {n} predictions to {args.output}.")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import DashboardServer
 
@@ -476,6 +513,15 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--max-batch", type=int, default=64)
     d.add_argument("--max-wait-us", type=int, default=2000)
     d.set_defaults(fn=cmd_deploy)
+
+    bp = sub.add_parser("batchpredict", help="bulk predict from NDJSON queries")
+    bp.add_argument("--engine-json", default="engine.json")
+    bp.add_argument("--input", required=True)
+    bp.add_argument("--output", required=True)
+    bp.add_argument("--engine-instance-id", dest="engine_instance_id")
+    bp.add_argument("--query-partitions", type=int, default=256,
+                    help="queries per vectorized predict chunk")
+    bp.set_defaults(fn=cmd_batchpredict)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
     db.add_argument("--ip", default="0.0.0.0")

@@ -1,0 +1,146 @@
+"""Client SDK — EventClient / EngineClient over HTTP.
+
+Reference parity: the PredictionIO ecosystem ships a ``predictionio``
+Python SDK with ``EventClient`` (create_event/get_event/delete_event,
+``pio import``-style batch) and ``EngineClient`` (send_query).  Same
+surface here, stdlib-only, so reference users can port scripts by
+changing an import.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+__all__ = ["PredictionIOError", "EventClient", "EngineClient"]
+
+
+class PredictionIOError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+def _request(method: str, url: str, body: Optional[Any] = None,
+             timeout: float = 10.0) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+            return json.loads(payload) if payload else None
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            msg = json.loads(payload).get("message", "") if payload else ""
+        except json.JSONDecodeError:
+            msg = payload.decode(errors="replace")[:200]
+        raise PredictionIOError(e.code, msg) from None
+
+
+class EventClient:
+    """Talks to the Event Server (reference: predictionio.EventClient)."""
+
+    def __init__(self, access_key: str, url: str = "http://localhost:7070",
+                 channel: Optional[str] = None, timeout: float = 10.0):
+        self.access_key = access_key
+        self.base = url.rstrip("/")
+        self.channel = channel
+        self.timeout = timeout
+
+    def _qs(self, extra: Optional[Mapping[str, Any]] = None) -> str:
+        params: Dict[str, Any] = {"accessKey": self.access_key}
+        if self.channel:
+            params["channel"] = self.channel
+        if extra:
+            params.update({k: v for k, v in extra.items() if v is not None})
+        return urllib.parse.urlencode(params, doseq=True)
+
+    @staticmethod
+    def _iso(t) -> Optional[str]:
+        if t is None:
+            return None
+        if isinstance(t, _dt.datetime):
+            return t.isoformat()
+        return str(t)
+
+    def create_event(self, event: str, entity_type: str, entity_id: str,
+                     target_entity_type: Optional[str] = None,
+                     target_entity_id: Optional[str] = None,
+                     properties: Optional[Mapping[str, Any]] = None,
+                     event_time=None) -> str:
+        body: Dict[str, Any] = {
+            "event": event, "entityType": entity_type, "entityId": entity_id}
+        if target_entity_type:
+            body["targetEntityType"] = target_entity_type
+        if target_entity_id:
+            body["targetEntityId"] = target_entity_id
+        if properties:
+            body["properties"] = dict(properties)
+        if event_time is not None:
+            body["eventTime"] = self._iso(event_time)
+        out = _request("POST", f"{self.base}/events.json?{self._qs()}", body,
+                       self.timeout)
+        return out["eventId"]
+
+    def create_events(self, events: Sequence[Mapping[str, Any]]) -> List[Dict]:
+        """Batch ingest (reference: /batch/events.json, ≤50 per call)."""
+        return _request("POST", f"{self.base}/batch/events.json?{self._qs()}",
+                        list(events), self.timeout)
+
+    def get_event(self, event_id: str) -> Dict[str, Any]:
+        return _request("GET",
+                        f"{self.base}/events/{event_id}.json?{self._qs()}",
+                        timeout=self.timeout)
+
+    def delete_event(self, event_id: str) -> None:
+        _request("DELETE", f"{self.base}/events/{event_id}.json?{self._qs()}",
+                 timeout=self.timeout)
+
+    def find_events(self, **filters) -> List[Dict[str, Any]]:
+        """Filters: startTime, untilTime, entityType, entityId, event,
+        targetEntityType, targetEntityId, limit, reversed."""
+        qs = self._qs({k: (str(v).lower() if isinstance(v, bool) else v)
+                       for k, v in filters.items()})
+        try:
+            return _request("GET", f"{self.base}/events.json?{qs}",
+                            timeout=self.timeout)
+        except PredictionIOError as e:
+            if e.status == 404:
+                return []
+            raise
+
+    # Convenience wrappers (reference SDK surface).
+    def set_user(self, uid: str, properties=None, event_time=None) -> str:
+        return self.create_event("$set", "user", uid, properties=properties,
+                                 event_time=event_time)
+
+    def set_item(self, iid: str, properties=None, event_time=None) -> str:
+        return self.create_event("$set", "item", iid, properties=properties,
+                                 event_time=event_time)
+
+    def record_user_action_on_item(self, action: str, uid: str, iid: str,
+                                   properties=None, event_time=None) -> str:
+        return self.create_event(action, "user", uid, "item", iid,
+                                 properties, event_time)
+
+
+class EngineClient:
+    """Talks to a deployed engine (reference: predictionio.EngineClient)."""
+
+    def __init__(self, url: str = "http://localhost:8000",
+                 timeout: float = 10.0):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+
+    def send_query(self, query: Mapping[str, Any]) -> Dict[str, Any]:
+        return _request("POST", f"{self.base}/queries.json", dict(query),
+                        self.timeout)
+
+    def status(self) -> Dict[str, Any]:
+        return _request("GET", f"{self.base}/", timeout=self.timeout)

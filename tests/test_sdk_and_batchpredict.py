@@ -1,0 +1,119 @@
+"""Client SDK round-trips + `pio batchpredict` CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App, get_storage
+from predictionio_tpu.sdk import EngineClient, EventClient, PredictionIOError
+from predictionio_tpu.server import EngineServer, EventServer
+from predictionio_tpu.templates.recommendation import engine
+from predictionio_tpu.workflow.core_workflow import run_train
+
+
+@pytest.fixture()
+def event_stack(pio_home):
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="app1"))
+    storage.get_events().init(app_id)
+    key = storage.get_access_keys().insert(AccessKey(key="", app_id=app_id))
+    srv = EventServer(storage=storage, host="127.0.0.1", port=0)
+    srv.start()
+    yield srv, key, storage, app_id
+    srv.stop()
+
+
+class TestEventClient:
+    def test_crud_roundtrip(self, event_stack):
+        srv, key, *_ = event_stack
+        c = EventClient(key, f"http://127.0.0.1:{srv.port}")
+        eid = c.record_user_action_on_item("rate", "u1", "i1",
+                                           {"rating": 4.5})
+        got = c.get_event(eid)
+        assert got["event"] == "rate" and got["properties"]["rating"] == 4.5
+        assert c.find_events(entityId="u1")
+        c.delete_event(eid)
+        with pytest.raises(PredictionIOError):
+            c.get_event(eid)
+
+    def test_batch_and_helpers(self, event_stack):
+        srv, key, *_ = event_stack
+        c = EventClient(key, f"http://127.0.0.1:{srv.port}")
+        res = c.create_events([
+            {"event": "view", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1"},
+            {"event": "view", "entityType": "user", "entityId": "u2",
+             "targetEntityType": "item", "targetEntityId": "i2"},
+        ])
+        assert [r["status"] for r in res] == [201, 201]
+        c.set_user("u3", {"age": 30})
+        assert c.find_events(entityId="u3")[0]["properties"]["age"] == 30
+
+    def test_bad_key(self, event_stack):
+        srv, *_ = event_stack
+        c = EventClient("WRONG", f"http://127.0.0.1:{srv.port}")
+        with pytest.raises(PredictionIOError) as ei:
+            c.set_user("u")
+        assert ei.value.status == 401
+
+
+def _train_reco(ctx):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(0)
+    for u in range(10):
+        for i in range(8):
+            if i % 2 == u % 2 and rng.random() < 0.95:
+                storage.get_events().insert(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": 4.0})), app_id)
+    variant_dict = {
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "testapp"}},
+        "algorithms": [{"name": "als", "params": {"rank": 4, "numIterations": 5}}],
+    }
+    variant = EngineVariant.from_dict(variant_dict)
+    eng = engine()
+    run_train(eng, variant, ctx)
+    return eng, variant, variant_dict
+
+
+def test_engine_client(pio_home):
+    storage = get_storage()
+    ctx = RuntimeContext.create(storage=storage)
+    eng, variant, _ = _train_reco(ctx)
+    srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        c = EngineClient(f"http://127.0.0.1:{srv.port}")
+        assert c.status()["status"] == "alive"
+        res = c.send_query({"user": "u0", "num": 3})
+        assert len(res["itemScores"]) == 3
+    finally:
+        srv.stop()
+
+
+def test_cli_batchpredict(pio_home, tmp_path):
+    from predictionio_tpu.cli.main import main
+
+    storage = get_storage()
+    ctx = RuntimeContext.create(storage=storage)
+    _, _, variant_dict = _train_reco(ctx)
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps(variant_dict))
+    qfile = tmp_path / "queries.ndjson"
+    qfile.write_text("\n".join(
+        json.dumps({"user": f"u{i}", "num": 2}) for i in range(5)))
+    out = tmp_path / "preds.ndjson"
+    rc = main(["batchpredict", "--engine-json", str(ej),
+               "--input", str(qfile), "--output", str(out)])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 5
+    assert all(len(l["prediction"]["itemScores"]) == 2 for l in lines)
+    assert lines[0]["query"]["user"] == "u0"

@@ -1,0 +1,55 @@
+"""First-order Markov chain over item transitions.
+
+Reference: e2/src/main/scala/.../engine/MarkovChain.scala (SURVEY.md §2.1
+"e2") — transition counts from observed state sequences, row-normalized,
+top-K next-state prediction.  TPU shape: counts are one scatter-add on
+device; prediction is a row gather + ``lax.top_k``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MarkovChainModel", "train_markov_chain", "predict_next"]
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    transition: jax.Array   # [S, S] row-stochastic (Laplace-smoothed)
+    n_states: int
+
+
+def train_markov_chain(
+    prev_states: np.ndarray,
+    next_states: np.ndarray,
+    n_states: int,
+    *,
+    smoothing: float = 0.0,
+) -> MarkovChainModel:
+    """Estimate P(next | prev) from transition pairs."""
+    prev_j = jnp.asarray(prev_states, jnp.int32)
+    next_j = jnp.asarray(next_states, jnp.int32)
+
+    @jax.jit
+    def _counts(p, q):
+        flat = p.astype(jnp.int64) * n_states + q.astype(jnp.int64)
+        c = jnp.zeros((n_states * n_states,), jnp.float32)
+        c = c.at[flat].add(1.0)
+        return c.reshape(n_states, n_states)
+
+    counts = _counts(prev_j, next_j) + smoothing
+    row = counts.sum(axis=1, keepdims=True)
+    transition = jnp.where(row > 0, counts / jnp.maximum(row, 1e-12), 0.0)
+    return MarkovChainModel(transition=transition, n_states=n_states)
+
+
+def predict_next(model: MarkovChainModel, states: jax.Array,
+                 k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k next states per input state: ([B,k] probs, [B,k] ids)."""
+    rows = model.transition[jnp.asarray(states)]
+    return jax.lax.top_k(rows, k)

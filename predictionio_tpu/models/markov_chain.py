@@ -37,7 +37,7 @@ def train_markov_chain(
 
     @jax.jit
     def _counts(p, q):
-        flat = p.astype(jnp.int64) * n_states + q.astype(jnp.int64)
+        flat = p * n_states + q  # int32 is ample: S² < 2³¹ for any real S
         c = jnp.zeros((n_states * n_states,), jnp.float32)
         c = c.at[flat].add(1.0)
         return c.reshape(n_states, n_states)

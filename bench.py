@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Headline benchmark: ALS training throughput (samples/sec/chip).
 
-Workload: MovieLens-100k-scale synthetic ratings (943 users x 1682 items,
-100k ratings — the BASELINE.md sanity config, same marginals), rank 64,
-explicit ALS-WR.  Data is generated deterministically because the
-environment has no dataset egress; shapes and sparsity match ML-100k.
+Workload: MovieLens-1M-scale synthetic ratings (6040 users x 3706 items,
+1M ratings, zipf item popularity), rank 64, explicit ALS-WR — a step
+toward the ML-25M north star that still finishes in seconds.  Data is
+generated deterministically because the environment has no dataset egress;
+shapes and sparsity match ML-1M.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` compares against the reference's Spark-local MLlib ALS on
@@ -18,11 +19,11 @@ import time
 
 import numpy as np
 
-REF_BASELINE_SAMPLES_PER_SEC = 250_000.0  # Spark-local MLlib ALS, ML-100k scale
+REF_BASELINE_SAMPLES_PER_SEC = 250_000.0  # Spark-local MLlib ALS, ML scale
 
-N_USERS = 943
-N_ITEMS = 1682
-N_RATINGS = 100_000
+N_USERS = 6040
+N_ITEMS = 3706
+N_RATINGS = 1_000_000
 RANK = 64
 ITERATIONS = 10
 

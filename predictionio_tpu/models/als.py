@@ -33,6 +33,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.linalg import gram, masked_gram
+from predictionio_tpu.ops.pallas_kernels import (
+    fits_vmem,
+    fused_gram_vector_pallas,
+    pallas_supported,
+)
 from predictionio_tpu.ops.ragged import Padded, bucket_by_length
 from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA
@@ -48,9 +53,13 @@ class ALSConfig:
     alpha: float = 1.0         # implicit confidence scale
     implicit: bool = False
     max_degree: Optional[int] = None   # truncate overlong entities (None = exact)
-    bucket_bounds: Sequence[int] = (16, 64, 256, 1024)
+    bucket_bounds: Sequence[int] = (16, 64, 256, 1024, 4096, 16384)
     seed: int = 42
     dtype: str = "float32"     # factor storage dtype; solves always f32
+    use_pallas: Optional[bool] = None  # None = auto (on for single-chip TPU)
+    # HBM guard: cap the gathered [rows, L, K] block at this many floats;
+    # jumbo buckets are solved in row chunks (≈1 GB at the default).
+    max_block_floats: int = 1 << 28
 
 
 @dataclasses.dataclass
@@ -75,6 +84,7 @@ def _solve_bucket(
     reg: jax.Array,        # scalar λ
     alpha: jax.Array,      # scalar α
     implicit: bool,
+    use_pallas: bool,
 ) -> jax.Array:
     """One padded block of normal equations + Cholesky solves → [R, K]."""
     f = factors[indices]                      # [R, L, K] gather
@@ -83,14 +93,18 @@ def _solve_bucket(
         # Hu-Koren-Volinsky per MLlib: c = 1 + α·|r|, p = 1(r>0).
         # A = YᵀY + Σ (c-1)·y yᵀ,  b = Σ c·p·y — (c-1) ≥ 0 keeps A PSD.
         w = alpha * jnp.abs(values) * m       # c - 1
-        p = (values > 0).astype(jnp.float32) * m
-        a = yty[None, :, :] + masked_gram(f, w)
-        b = jnp.einsum("blk,bl->bk", f, (1.0 + w) * p,
-                       preferred_element_type=jnp.float32)
+        cvec = (1.0 + w) * (values > 0).astype(jnp.float32) * m
     else:
-        a = masked_gram(f, m)
-        b = jnp.einsum("blk,bl->bk", f, values * m,
+        w = m
+        cvec = values * m
+    if use_pallas:
+        a, b = fused_gram_vector_pallas(f, w, cvec)
+    else:
+        a = masked_gram(f, w)
+        b = jnp.einsum("blk,bl->bk", f, cvec,
                        preferred_element_type=jnp.float32)
+    if implicit:
+        a = yty[None, :, :] + a
     degree = jnp.maximum(m.sum(axis=1), 1.0)  # ALS-WR: λ·n_u
     return _ridge(a, b, reg * degree)
 
@@ -116,29 +130,54 @@ def _scatter_rows(dst: jax.Array, row_ids: jax.Array, rows: jax.Array) -> jax.Ar
     return dst.at[safe].set(rows, mode="drop")
 
 
-@functools.partial(jax.jit, static_argnames=("implicit",))
+@functools.partial(jax.jit, static_argnames=("implicit", "use_pallas"))
 def _side_step(
-    indices, values, mask, row_ids, dst_factors, src_factors, reg, alpha, *, implicit
+    indices, values, mask, row_ids, dst_factors, src_factors, reg, alpha, *,
+    implicit, use_pallas,
 ):
     yty = gram(src_factors) if implicit else jnp.zeros(
         (src_factors.shape[1], src_factors.shape[1]), jnp.float32)
-    solved = _solve_bucket(indices, values, mask, src_factors, yty, reg, alpha, implicit)
+    solved = _solve_bucket(indices, values, mask, src_factors, yty, reg, alpha,
+                           implicit, use_pallas)
     return _scatter_rows(dst_factors, row_ids, solved)
 
 
-def _device_buckets(buckets: List[Padded], mesh: Optional[Mesh]) -> List[Tuple]:
+def _device_buckets(
+    buckets: List[Padded],
+    mesh: Optional[Mesh],
+    rank: int,
+    max_block_floats: int,
+    pad_rows: int,
+) -> List[Tuple]:
+    """Transfer padded buckets, splitting any whose gathered [R, L, K]
+    block would exceed the HBM budget into fixed-shape row chunks (last
+    chunk row-padded with row_id = -1, which the scatter drops)."""
     out = []
     for p in buckets:
-        arrs = (
-            jnp.asarray(p.indices), jnp.asarray(p.values),
-            jnp.asarray(p.mask), jnp.asarray(p.row_ids),
-        )
-        if mesh is not None:
-            row = NamedSharding(mesh, P(AXIS_DATA))
-            arrs = tuple(
-                jax.device_put(a, row if a.ndim >= 1 else None) for a in arrs
-            )
-        out.append(arrs)
+        r, l = p.indices.shape
+        rows_max = max(pad_rows, (max_block_floats // max(l * rank, 1))
+                       // pad_rows * pad_rows)
+        chunks = [(p.indices, p.values, p.mask, p.row_ids)] if r <= rows_max \
+            else []
+        if r > rows_max:
+            for start in range(0, r, rows_max):
+                sl = slice(start, start + rows_max)
+                idx, vals = p.indices[sl], p.values[sl]
+                msk, rid = p.mask[sl], p.row_ids[sl]
+                short = rows_max - idx.shape[0]
+                if short:
+                    idx = np.pad(idx, ((0, short), (0, 0)))
+                    vals = np.pad(vals, ((0, short), (0, 0)))
+                    msk = np.pad(msk, ((0, short), (0, 0)))
+                    rid = np.pad(rid, (0, short), constant_values=-1)
+                chunks.append((idx, vals, msk, rid))
+        for idx, vals, msk, rid in chunks:
+            arrs = (jnp.asarray(idx), jnp.asarray(vals),
+                    jnp.asarray(msk), jnp.asarray(rid))
+            if mesh is not None:
+                row = NamedSharding(mesh, P(AXIS_DATA))
+                arrs = tuple(jax.device_put(a, row) for a in arrs)
+            out.append(arrs)
     return out
 
 
@@ -173,23 +212,39 @@ def train_als(
         bucket_by_length(user_ids, item_ids, ratings, n_users,
                          bucket_bounds=config.bucket_bounds,
                          max_len=config.max_degree, pad_rows_to=pad_rows),
-        mesh,
+        mesh, k, config.max_block_floats, pad_rows,
     )
     item_buckets = _device_buckets(
         bucket_by_length(item_ids, user_ids, ratings, n_items,
                          bucket_bounds=config.bucket_bounds,
                          max_len=config.max_degree, pad_rows_to=pad_rows),
-        mesh,
+        mesh, k, config.max_block_floats, pad_rows,
     )
     reg = jnp.float32(config.reg)
     alpha = jnp.float32(config.alpha)
+    use_pallas = config.use_pallas
+    if use_pallas is None:
+        # Default OFF: measured on v5e, XLA fuses the factor gather into
+        # the einsum consumer (no [R,L,K] materialization), which beats the
+        # fused kernel fed from materialized inputs.  The kernel stays
+        # available for explicit opt-in; a gather-inside-kernel variant
+        # (scalar-prefetch indices + per-row DMA) is the follow-up that
+        # could win outright.
+        use_pallas = False
+    def _bucket_pallas(idx) -> bool:
+        # Jumbo buckets (max-degree outliers) exceed the per-program VMEM
+        # tile budget — those take the einsum path.
+        return use_pallas and fits_vmem(idx.shape[1], k)
+
     for _ in range(config.iterations):
         for idx, vals, msk, rid in user_buckets:
             uf = _side_step(idx, vals, msk, rid, uf, itf, reg, alpha,
-                            implicit=config.implicit)
+                            implicit=config.implicit,
+                            use_pallas=_bucket_pallas(idx))
         for idx, vals, msk, rid in item_buckets:
             itf = _side_step(idx, vals, msk, rid, itf, uf, reg, alpha,
-                             implicit=config.implicit)
+                             implicit=config.implicit,
+                             use_pallas=_bucket_pallas(idx))
     return ALSModel(user_factors=uf, item_factors=itf, rank=k,
                     implicit=config.implicit)
 

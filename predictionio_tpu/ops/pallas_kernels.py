@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the ALS hot loop.
+
+SURVEY.md §7 flags the ragged→dense gather/gram layout as "likely the one
+place a Pallas kernel pays off".  The XLA formulation of the per-entity
+normal equations reads the gathered factor block ``F [R, L, K]`` from HBM
+twice (once for ``A = Fᵀ·diag(w)·F``, once for ``b = Fᵀ·c``).  The fused
+kernel below tiles rows into VMEM once and emits both outputs per pass —
+halving HBM traffic on the training hot loop.
+
+Grid: one program per solve row; per-program working set is
+``L·K + K² + K`` floats (≤ ~0.6 MB at L=1024, K=128 — well inside VMEM).
+Matmuls sit on the MXU via ``dot_general`` with f32 accumulation.
+
+On CPU (tests) the kernel runs in interpret mode; ``fused_gram_vector``
+dispatches to the plain einsum path unless Pallas is requested/available.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_gram_vector", "fused_gram_vector_pallas",
+           "fused_gram_vector_xla", "pallas_supported"]
+
+
+def pallas_supported() -> bool:
+    """True when the default backend can run the compiled kernel."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# Per-program VMEM budget: double-buffered input tile must fit comfortably
+# inside ~16 MB/core alongside outputs.  2 × TILE_R × L × K × 4B ≤ 8 MB.
+_VMEM_BUDGET_FLOATS = 1 << 20  # L·K per row
+
+
+def fits_vmem(l: int, k: int) -> bool:
+    """Whether a [TILE_R, l, k] f32 tile double-buffers within VMEM."""
+    return l * k <= _VMEM_BUDGET_FLOATS // TILE_R
+
+
+def fused_gram_vector_xla(f: jax.Array, w: jax.Array, c: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Reference path: ``A[r] = Σ_l w[r,l]·f[r,l]⊗f[r,l]``, ``b[r] = Σ_l
+    c[r,l]·f[r,l]`` via two einsums (XLA fuses what it can)."""
+    a = jnp.einsum("blk,bl,blm->bkm", f, w, f,
+                   preferred_element_type=jnp.float32)
+    b = jnp.einsum("blk,bl->bk", f, c, preferred_element_type=jnp.float32)
+    return a, b
+
+
+TILE_R = 8  # rows per program — TPU sublane granularity for f32
+
+
+def _kernel(f_ref, w_ref, c_ref, a_ref, b_ref):
+    # f: [TILE_R, L, K] in VMEM; w/c: [TILE_R, L].  Static 8-row unroll of
+    # plain 2-D MXU dots — Mosaic lowers these directly (the batched 3-D
+    # dot_general form does not lower).
+    for r in range(TILE_R):
+        f = f_ref[r]                              # [L, K]
+        fw = f * w_ref[r][:, None]                # VPU
+        a_ref[r] = jax.lax.dot_general(           # MXU: [K,L]·[L,K]
+            fw, f, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        b_ref[r] = jax.lax.dot_general(           # MXU: [1,L]·[L,K]
+            c_ref[r][None, :], f,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_gram_vector_pallas(f: jax.Array, w: jax.Array, c: jax.Array,
+                             *, interpret: bool = False
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """Fused (A, b) build — one VMEM pass over the gathered factors.
+
+    Rows are padded up to the TILE_R sublane granule; padding rows compute
+    garbage that is sliced off (their weights are whatever padding holds —
+    never read).
+    """
+    r, l, k = f.shape
+    r_pad = (-r) % TILE_R
+    if r_pad:
+        f = jnp.pad(f, ((0, r_pad), (0, 0), (0, 0)))
+        w = jnp.pad(w, ((0, r_pad), (0, 0)))
+        c = jnp.pad(c, ((0, r_pad), (0, 0)))
+    rp = r + r_pad
+    grid = (rp // TILE_R,)
+    a, b = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_R, l, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((TILE_R, l), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_R, l), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TILE_R, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((TILE_R, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, k, k), jnp.float32),
+            jax.ShapeDtypeStruct((rp, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(f.astype(jnp.float32), w.astype(jnp.float32), c.astype(jnp.float32))
+    return a[:r], b[:r]
+
+
+def fused_gram_vector(f: jax.Array, w: jax.Array, c: jax.Array,
+                      *, use_pallas: Optional[bool] = None
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Dispatch: Pallas on TPU, einsum elsewhere (or force via flag)."""
+    if use_pallas is None:
+        use_pallas = pallas_supported()
+    if use_pallas:
+        return fused_gram_vector_pallas(f, w, c,
+                                        interpret=not pallas_supported())
+    return fused_gram_vector_xla(f, w, c)

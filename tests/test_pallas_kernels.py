@@ -1,0 +1,46 @@
+"""Pallas fused gram kernel == einsum oracle (interpret mode on CPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from predictionio_tpu.ops.pallas_kernels import (
+    fused_gram_vector,
+    fused_gram_vector_pallas,
+    fused_gram_vector_xla,
+)
+
+
+def _inputs(seed=0, r=6, l=16, k=8):
+    rng = np.random.default_rng(seed)
+    f = rng.standard_normal((r, l, k)).astype(np.float32)
+    w = np.abs(rng.standard_normal((r, l))).astype(np.float32)
+    c = rng.standard_normal((r, l)).astype(np.float32)
+    return jnp.asarray(f), jnp.asarray(w), jnp.asarray(c)
+
+
+def test_pallas_matches_einsum():
+    f, w, c = _inputs()
+    a1, b1 = fused_gram_vector_xla(f, w, c)
+    a2, b2 = fused_gram_vector_pallas(f, w, c, interpret=True)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(b1), np.asarray(b2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_matches_numpy_oracle():
+    f, w, c = _inputs(seed=1, r=3, l=5, k=4)
+    a, b = fused_gram_vector_pallas(f, w, c, interpret=True)
+    fn, wn, cn = map(np.asarray, (f, w, c))
+    for r in range(3):
+        expect_a = (fn[r] * wn[r][:, None]).T @ fn[r]
+        expect_b = fn[r].T @ cn[r]
+        np.testing.assert_allclose(np.asarray(a[r]), expect_a, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(b[r]), expect_b, rtol=1e-5)
+
+
+def test_dispatcher_cpu_path():
+    f, w, c = _inputs(seed=2)
+    a, b = fused_gram_vector(f, w, c)  # auto: einsum on CPU
+    a2, b2 = fused_gram_vector_xla(f, w, c)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(a2), rtol=1e-6)

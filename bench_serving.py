@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Serving benchmark: recs/sec + predict latency percentiles.
+
+BASELINE.md metrics 2-3: serving throughput (recommendations/sec) and p50
+predict latency.  Trains a small ALS engine, then drives both serving
+frontends over real HTTP with concurrent closed-loop clients:
+
+- python: stdlib ThreadingHTTPServer (`pio deploy`)
+- native: C++ continuous-batching frontend (`pio deploy --native`)
+
+Usage: python bench_serving.py [--clients 16] [--requests 2000]
+Prints one JSON line per frontend.
+"""
+
+import argparse
+import concurrent.futures
+import json
+import os
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _setup():
+    os.environ.setdefault("PIO_HOME", tempfile.mkdtemp(prefix="pio_bench_"))
+    from predictionio_tpu.controller import EngineVariant, RuntimeContext
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.templates.recommendation import engine
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    storage = get_storage()
+    ctx = RuntimeContext.create(storage=storage)
+    app_id = storage.get_apps().insert(App(id=None, name="benchapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(0)
+    n_users, n_items = 2000, 4000
+    users = rng.integers(0, n_users, 100_000)
+    items = rng.integers(0, n_items, 100_000)
+    events = storage.get_events()
+    batch = [
+        Event(event="rate", entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({"rating": float(r)}))
+        for u, i, r in zip(users, items, rng.integers(1, 6, 100_000))
+    ]
+    events.insert_batch(batch, app_id)
+    variant = EngineVariant.from_dict({
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "benchapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 64, "numIterations": 5}}],
+    })
+    eng = engine()
+    run_train(eng, variant, ctx)
+    return eng, variant, storage, n_users
+
+
+def _drive(port: int, n_users: int, clients: int, requests: int):
+    url = f"http://127.0.0.1:{port}/queries.json"
+    rng = np.random.default_rng(1)
+    payloads = [json.dumps({"user": f"u{rng.integers(0, n_users)}",
+                            "num": 10}).encode() for _ in range(requests)]
+    latencies = []
+
+    def one(body):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+        return (time.perf_counter() - t0) * 1e3
+
+    # Warmup (compile batch shapes).
+    for body in payloads[:20]:
+        one(body)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+        latencies = list(ex.map(one, payloads))
+    wall = time.perf_counter() - t0
+    lat = np.array(latencies)
+    return {
+        "throughput_rps": round(requests / wall, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p95_ms": round(float(np.percentile(lat, 95)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=2000)
+    args = ap.parse_args()
+
+    eng, variant, storage, n_users = _setup()
+    from predictionio_tpu.server import EngineServer
+
+    srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+    srv.start()
+    res = _drive(srv.port, n_users, args.clients, args.requests)
+    srv.stop()
+    print(json.dumps({"frontend": "python", **res}))
+
+    try:
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
+                            max_batch=64, max_wait_us=1000)
+        fe.start()
+        res = _drive(fe.port, n_users, args.clients, args.requests)
+        fe.stop()
+        print(json.dumps({"frontend": "native", **res}))
+    except RuntimeError as e:
+        print(json.dumps({"frontend": "native", "error": str(e)}))
+
+
+if __name__ == "__main__":
+    main()

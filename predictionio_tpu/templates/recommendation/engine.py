@@ -250,16 +250,26 @@ class ALSAlgorithm(Algorithm):
         )
 
     def batch_predict(self, model: ALSModelWrapper, queries):
-        """Vectorized eval path: one batched matmul for all queries."""
+        """Vectorized eval/serving path: one batched matmul for all queries.
+
+        The user batch is padded to the next power of two and ``num`` to a
+        small menu of K values so only a handful of XLA programs ever
+        compile (SURVEY.md §7: continuous batching with a few compiled
+        batch sizes) — without this, every distinct batch size arriving
+        from the serving frontend triggers a fresh compile.
+        """
         known = [(i, q) for i, q in queries if q.user in model.user_index]
         out = [(i, PredictedResult(itemScores=[])) for i, q in queries
                if q.user not in model.user_index]
         if known:
             num = max(q.num for _, q in known)
-            uidx = jnp.asarray([model.user_index[q.user] for _, q in known])
-            scores, ids = als_lib.recommend(
-                model.model, uidx, min(num, len(model.item_index))
-            )
+            b = 1 << (len(known) - 1).bit_length()  # next pow2
+            idxs = [model.user_index[q.user] for _, q in known]
+            uidx = jnp.asarray(idxs + [0] * (b - len(idxs)))
+            k_menu = (1, 10, 100, 1000)
+            k = min(len(model.item_index),
+                    next((m for m in k_menu if m >= num), num))
+            scores, ids = als_lib.recommend(model.model, uidx, k)
             inv = model.item_index.inverse
             for row, (i, q) in enumerate(known):
                 out.append((i, PredictedResult(itemScores=[

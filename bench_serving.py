@@ -73,9 +73,12 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
             r.read()
         return (time.perf_counter() - t0) * 1e3
 
-    # Warmup (compile batch shapes).
-    for body in payloads[:20]:
+    # Warmup: sequential (B=1 path), then concurrent bursts so every pow2
+    # batch size the continuous batcher can form gets compiled pre-timing.
+    for body in payloads[:5]:
         one(body)
+    with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+        list(ex.map(one, payloads[: 8 * clients]))
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(clients) as ex:
         latencies = list(ex.map(one, payloads))

@@ -21,6 +21,7 @@ __all__ = ["top_k_scores", "chunked_top_k", "sharded_top_k"]
 NEG_INF = jnp.float32(-3.4e38)
 
 
+@partial(jax.jit, static_argnames=("k",))
 def top_k_scores(
     queries: jax.Array,   # [B, K] float
     items: jax.Array,     # [N, K] float
@@ -29,7 +30,11 @@ def top_k_scores(
     exclude: Optional[jax.Array] = None,  # [B, N] bool — True = mask out
     biases: Optional[jax.Array] = None,   # [N] additive item biases
 ) -> Tuple[jax.Array, jax.Array]:
-    """Scores+ids of the top-k items per query. Returns ([B,k], [B,k] int32)."""
+    """Scores+ids of the top-k items per query. Returns ([B,k], [B,k] int32).
+
+    Jitted (k static): the serving hot path must be ONE dispatch, not
+    eager op-by-op — on a tunneled TPU each eager op is a network RTT.
+    """
     scores = jnp.einsum(
         "bk,nk->bn", queries, items, preferred_element_type=jnp.float32
     )

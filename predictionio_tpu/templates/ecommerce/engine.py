@@ -23,6 +23,7 @@ import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -239,8 +240,9 @@ class ECommAlgorithm(Algorithm):
             scores, ids = top_k_scores(
                 q, jnp.asarray(model.item_factors),
                 min(query.num, n_items), exclude=jnp.asarray(exclude))
+            scores, ids = jax.device_get((scores, ids))  # ONE host transfer
             pairs = [(float(s), int(i))
-                     for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0]))
+                     for s, i in zip(scores[0], ids[0])
                      if s > -1e37]
         else:
             # Popularity fallback (reference: predictDefault).

@@ -22,6 +22,7 @@ import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -183,8 +184,9 @@ class ALSAlgorithm(Algorithm):
 
         k = min(query.num, n_items)
         scores, ids = top_k_scores(q, f, k, exclude=jnp.asarray(exclude))
+        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
         out = []
-        for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0])):
+        for s, i in zip(scores[0], ids[0]):
             if s <= -1e37:  # ran out of unmasked candidates
                 break
             out.append(ItemScore(item=inv[int(i)], score=float(s)))

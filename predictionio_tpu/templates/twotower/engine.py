@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -150,10 +151,11 @@ class TwoTowerAlgorithm(Algorithm):
         q = jnp.asarray(model.user_vecs[uidx][None, :])
         k = min(query.num, model.item_vecs.shape[0])
         scores, ids = top_k_scores(q, jnp.asarray(model.item_vecs), k)
+        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
         inv = model.item_index.inverse
         return PredictedResult(itemScores=[
             ItemScore(item=inv[int(i)], score=float(s))
-            for s, i in zip(np.asarray(scores[0]), np.asarray(ids[0]))])
+            for s, i in zip(scores[0], ids[0])])
 
 
 def engine() -> Engine:

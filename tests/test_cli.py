@@ -81,3 +81,33 @@ def test_status(clean_storage, capsys):
 def test_bad_engine_json(clean_storage, capsys):
     with pytest.raises(SystemExit):
         run(capsys, "train", "--engine-json", "/nonexistent/engine.json")
+
+
+def test_build_validates_engine_json(clean_storage, capsys, tmp_path):
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps({
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "x"}},
+        "algorithms": [{"name": "als", "params": {"rank": 4}}],
+    }))
+    code, out = run(capsys, "build", "--engine-json", str(ej))
+    assert code == 0 and "Build successful" in out
+
+
+def test_build_rejects_bad_params(clean_storage, capsys, tmp_path):
+    ej = tmp_path / "engine.json"
+    ej.write_text(json.dumps({
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "x"}},
+        "algorithms": [{"name": "als", "params": {"rankk": 4}}],
+    }))
+    code, _ = run(capsys, "build", "--engine-json", str(ej))
+    assert code == 1
+
+
+def test_channel_lifecycle(clean_storage, capsys):
+    run(capsys, "app", "new", "capp")
+    code, out = run(capsys, "app", "channel-new", "capp", "mobile")
+    assert code == 0 and "mobile" in out
+    code, out = run(capsys, "app", "channel-delete", "capp", "mobile")
+    assert code == 0

@@ -67,10 +67,17 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
 
     def one(body):
         t0 = time.perf_counter()
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=30) as r:
-            r.read()
+        for attempt in range(3):
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                break
+            except (ConnectionError, OSError):
+                if attempt == 2:
+                    raise
         return (time.perf_counter() - t0) * 1e3
 
     # Warmup: sequential (B=1 path), then concurrent bursts so every pow2

@@ -389,6 +389,19 @@ def cmd_batchpredict(args) -> int:
     return 0
 
 
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.server.admin import AdminServer
+
+    srv = AdminServer(storage=_storage(), host=args.ip, port=args.port)
+    srv.start(block=False)
+    print(f"Admin server listening on {args.ip}:{srv.port} (Ctrl-C to stop)")
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import DashboardServer
 
@@ -547,6 +560,11 @@ def build_parser() -> argparse.ArgumentParser:
     bp.add_argument("--query-partitions", type=int, default=256,
                     help="queries per vectorized predict chunk")
     bp.set_defaults(fn=cmd_batchpredict)
+
+    adm = sub.add_parser("adminserver", help="app-management REST API server")
+    adm.add_argument("--ip", default="0.0.0.0")
+    adm.add_argument("--port", type=int, default=7071)
+    adm.set_defaults(fn=cmd_adminserver)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
     db.add_argument("--ip", default="0.0.0.0")

@@ -257,6 +257,12 @@ def predict_scores(user_factors: jax.Array, item_factors: jax.Array,
                       preferred_element_type=jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _recommend_impl(user_factors, item_factors, user_indices, seen, *, k):
+    q = user_factors[user_indices]
+    return top_k_scores(q, item_factors, k, exclude=seen)
+
+
 def recommend(
     model: ALSModel,
     user_indices: jax.Array,          # [B] int
@@ -265,11 +271,16 @@ def recommend(
     seen: Optional[jax.Array] = None,  # [B, n_items] bool — exclude
     chunk: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Top-k items per user (reference: MLlib recommendProducts)."""
-    q = model.user_factors[user_indices]
+    """Top-k items per user (reference: MLlib recommendProducts).
+
+    Gather + score + top-k is ONE jitted dispatch — the serving path's
+    latency budget is dominated by per-call round-trips, not FLOPs.
+    """
     if chunk:
+        q = model.user_factors[user_indices]
         return chunked_top_k(q, model.item_factors, k, chunk=chunk)
-    return top_k_scores(q, model.item_factors, k, exclude=seen)
+    return _recommend_impl(model.user_factors, model.item_factors,
+                           user_indices, seen, k=k)
 
 
 def rmse(model: ALSModel, user_ids, item_ids, ratings) -> float:

@@ -1,0 +1,48 @@
+"""Admin REST server (reference: tools/admin, pio adminserver)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.storage import get_storage
+from predictionio_tpu.server.admin import AdminServer
+
+
+@pytest.fixture()
+def admin(pio_home):
+    srv = AdminServer(storage=get_storage(), host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _req(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else None
+
+
+def test_app_crud(admin):
+    base = f"http://127.0.0.1:{admin.port}"
+    s, body = _req("POST", f"{base}/v1/cmd/app", {"name": "a1"})
+    assert s == 201 and body["accessKey"]
+    s, body = _req("POST", f"{base}/v1/cmd/app", {"name": "a1"})
+    assert s == 409
+    s, apps = _req("GET", f"{base}/v1/cmd/app")
+    assert s == 200 and apps[0]["name"] == "a1" and apps[0]["accessKeys"]
+    s, _ = _req("DELETE", f"{base}/v1/cmd/app/a1/data")
+    assert s == 200
+    s, _ = _req("DELETE", f"{base}/v1/cmd/app/a1")
+    assert s == 200
+    s, apps = _req("GET", f"{base}/v1/cmd/app")
+    assert apps == []
+    s, _ = _req("DELETE", f"{base}/v1/cmd/app/ghost")
+    assert s == 404

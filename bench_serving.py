@@ -78,6 +78,7 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
             except (ConnectionError, OSError):
                 if attempt == 2:
                     raise
+                time.sleep(0.05 * (attempt + 1))
         return (time.perf_counter() - t0) * 1e3
 
     # Warmup: sequential (B=1 path), then concurrent bursts so every pow2

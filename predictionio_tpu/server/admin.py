@@ -16,7 +16,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
+
+
+class ThreadingHTTPServer(_ThreadingHTTPServer):
+    # Default accept backlog (5) resets connections under load bursts.
+    request_queue_size = 128
 from typing import Optional, Tuple
 from urllib.parse import urlparse
 

@@ -20,7 +20,12 @@ import json
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
+
+
+class ThreadingHTTPServer(_ThreadingHTTPServer):
+    # Default accept backlog (5) resets connections under load bursts.
+    request_queue_size = 128
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 

@@ -1,0 +1,7 @@
+"""`python -m predictionio_tpu.cli` == the `pio` console entry point."""
+
+import sys
+
+from predictionio_tpu.cli.main import main
+
+sys.exit(main())

@@ -236,14 +236,18 @@ def cmd_accesskey_delete(args) -> int:
 
 def cmd_train(args) -> int:
     from predictionio_tpu.controller import EngineVariant, RuntimeContext, load_engine_factory
+    from predictionio_tpu.parallel.distributed import initialize_distributed
     from predictionio_tpu.workflow import run_train
 
+    initialize_distributed()
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
     variant = EngineVariant.from_file(variant_path)
     engine = load_engine_factory(variant.engine_factory)()
-    ctx = RuntimeContext.create(seed=args.seed)
+    ctx = RuntimeContext.create(seed=args.seed, mesh_spec=args.mesh)
+    if ctx.mesh is not None:
+        print(f"Mesh: {dict(ctx.mesh.shape)} over {ctx.mesh.devices.size} device(s)")
     instance_id = run_train(engine, variant, ctx)
     print(f"Training completed. Engine instance ID: {instance_id}")
     return 0
@@ -251,11 +255,13 @@ def cmd_train(args) -> int:
 
 def cmd_eval(args) -> int:
     from predictionio_tpu.controller import load_engine_factory, RuntimeContext
+    from predictionio_tpu.parallel.distributed import initialize_distributed
     from predictionio_tpu.workflow import run_evaluation
 
+    initialize_distributed()
     evaluation = load_engine_factory(args.evaluation_class)()
     generator = load_engine_factory(args.params_generator_class)()
-    ctx = RuntimeContext.create(seed=args.seed)
+    ctx = RuntimeContext.create(seed=args.seed, mesh_spec=args.mesh)
     instance_id, result = run_evaluation(
         evaluation,
         generator,
@@ -313,8 +319,10 @@ def cmd_eventserver(args) -> int:
 
 def cmd_deploy(args) -> int:
     from predictionio_tpu.controller import EngineVariant, load_engine_factory
+    from predictionio_tpu.parallel.distributed import initialize_distributed
     from predictionio_tpu.server import EngineServer
 
+    initialize_distributed()
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -322,7 +330,7 @@ def cmd_deploy(args) -> int:
     engine = load_engine_factory(variant.engine_factory)()
     srv = EngineServer(
         engine, variant, _storage(), host=args.ip, port=args.port,
-        instance_id=args.engine_instance_id,
+        instance_id=args.engine_instance_id, mesh_spec=args.mesh,
     )
     if args.native:
         from predictionio_tpu.native.frontend import NativeFrontend
@@ -359,15 +367,18 @@ def cmd_batchpredict(args) -> int:
     vectorized XLA chunks, not per-line predicts.
     """
     from predictionio_tpu.controller import EngineVariant, load_engine_factory
+    from predictionio_tpu.parallel.distributed import initialize_distributed
     from predictionio_tpu.server import EngineServer
 
+    initialize_distributed()
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
     variant = EngineVariant.from_file(variant_path)
     engine = load_engine_factory(variant.engine_factory)()
     srv = EngineServer(engine, variant, _storage(),
-                       instance_id=args.engine_instance_id)
+                       instance_id=args.engine_instance_id,
+                       mesh_spec=args.mesh)
     queries = []
     with open(args.input) as f:
         for line_no, line in enumerate(f, 1):
@@ -527,12 +538,16 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train an engine variant")
     t.add_argument("--engine-json", default="engine.json")
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="device mesh, e.g. 'data=8,model=2' or 'auto' "
+                        "(default: env PIO_MESH, else single device)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="evaluate engine-params candidates")
     e.add_argument("evaluation_class")
     e.add_argument("params_generator_class")
     e.add_argument("--seed", type=int, default=0)
+    e.add_argument("--mesh", default=None, metavar="SPEC")
     e.add_argument("--output-json", dest="output_json")
     e.set_defaults(fn=cmd_eval)
 
@@ -546,6 +561,8 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--ip", default="0.0.0.0")
     d.add_argument("--port", type=int, default=8000)
     d.add_argument("--engine-instance-id", dest="engine_instance_id")
+    d.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="device mesh for model re-load/serve sharding")
     d.add_argument("--native", action="store_true",
                    help="serve via the C++ continuous-batching frontend")
     d.add_argument("--max-batch", type=int, default=64)
@@ -557,6 +574,7 @@ def build_parser() -> argparse.ArgumentParser:
     bp.add_argument("--input", required=True)
     bp.add_argument("--output", required=True)
     bp.add_argument("--engine-instance-id", dest="engine_instance_id")
+    bp.add_argument("--mesh", default=None, metavar="SPEC")
     bp.add_argument("--query-partitions", type=int, default=256,
                     help="queries per vectorized predict chunk")
     bp.set_defaults(fn=cmd_batchpredict)

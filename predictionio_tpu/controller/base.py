@@ -61,10 +61,35 @@ class RuntimeContext:
     workflow_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @staticmethod
-    def create(storage=None, mesh=None, seed: int = 0, **workflow_params) -> "RuntimeContext":
+    def create(
+        storage=None,
+        mesh=None,
+        seed: int = 0,
+        mesh_spec: Optional[str] = None,
+        **workflow_params,
+    ) -> "RuntimeContext":
+        """Build the run context; this is where multi-chip bring-up happens.
+
+        ``mesh_spec`` (or env ``PIO_MESH``, e.g. ``data=8,model=2`` /
+        ``auto``) constructs the device mesh every sharded model trains
+        over; multi-host gangs join first via ``initialize_distributed``
+        (env ``PIO_COORDINATOR_ADDRESS``).  Reference: where Spark's
+        context creation happened in CoreWorkflow (SURVEY.md §3.1), mesh
+        construction happens here — engines only consume ``ctx.mesh``.
+        """
+        import os
+
         from predictionio_tpu.data.store import EventStore
         from predictionio_tpu.data.storage import get_storage
 
+        if mesh is None:
+            spec = mesh_spec if mesh_spec is not None else os.environ.get("PIO_MESH")
+            if spec:
+                from predictionio_tpu.parallel.distributed import initialize_distributed
+                from predictionio_tpu.parallel.mesh import mesh_from_spec
+
+                initialize_distributed()
+                mesh = mesh_from_spec(spec)
         storage = storage or get_storage()
         return RuntimeContext(
             storage=storage,

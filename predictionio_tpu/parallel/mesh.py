@@ -31,6 +31,8 @@ __all__ = [
     "AXIS_SEQUENCE",
     "AXIS_EXPERT",
     "make_mesh",
+    "parse_mesh_spec",
+    "mesh_from_spec",
     "sharding",
     "batch_sharding",
     "replicated",
@@ -83,12 +85,62 @@ def make_mesh(
             )
         sizes[wild[0]] = n // fixed
     total = math.prod(sizes.values())
-    if total != n:
+    if total > n:
         raise ValueError(
             f"Mesh axes {sizes} need {total} devices but {n} are available."
         )
-    mesh_devices = np.array(devices).reshape(*sizes.values())
+    # Fewer than available is allowed (e.g. `--mesh data=2` on an 8-chip
+    # host): take a device prefix so small meshes work anywhere.
+    mesh_devices = np.array(devices[:total]).reshape(*sizes.values())
     return Mesh(mesh_devices, axis_names=tuple(sizes))
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse the CLI/env mesh spec (``pio train --mesh`` / ``PIO_MESH``).
+
+    Grammar: ``axis=size[,axis=size...]`` with at most one ``-1`` wildcard
+    (``data=-1,model=2``), or the shorthands ``auto`` (all devices on the
+    ``data`` axis) and a bare integer N (``data=N``).
+    """
+    spec = (spec or "").strip()
+    if not spec or spec.lower() == "auto":
+        return {AXIS_DATA: -1}
+    if spec.isdigit():
+        return {AXIS_DATA: int(spec)}
+    sizes: Dict[str, int] = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            raise ValueError(
+                f"Bad mesh spec token {tok!r}: expected axis=size "
+                "(e.g. 'data=8,model=2', 'data=-1', or 'auto')."
+            )
+        axis, _, size = tok.partition("=")
+        sizes[axis.strip()] = int(size)
+    for axis, size in sizes.items():
+        if size != -1 and size < 1:
+            raise ValueError(
+                f"Bad mesh axis size {axis}={size}: must be >= 1 "
+                "(or -1 to absorb remaining devices)."
+            )
+    return sizes
+
+
+def mesh_from_spec(
+    spec: str, *, devices: Optional[Sequence[jax.Device]] = None
+) -> Optional[Mesh]:
+    """Build a mesh from a CLI/env spec string; ``""``/``"none"`` → None.
+
+    This is the production entry point `pio train/deploy --mesh` and
+    ``PIO_MESH`` go through (SURVEY.md §2.5 — mesh bring-up is the
+    framework's, not the engine author's, job).
+    """
+    spec = (spec or "").strip()
+    if not spec or spec.lower() in ("none", "off"):
+        return None
+    return make_mesh(parse_mesh_spec(spec), devices=devices)
 
 
 def sharding(mesh: Mesh, *spec: Optional[str | Tuple[str, ...]]) -> NamedSharding:

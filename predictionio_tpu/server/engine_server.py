@@ -101,11 +101,12 @@ class EngineServer:
         engine_id: Optional[str] = None,
         engine_version: str = __version__,
         instance_id: Optional[str] = None,
+        mesh_spec: Optional[str] = None,
     ):
         self.engine = engine
         self.variant = variant
         self.storage = storage or get_storage()
-        self.ctx = RuntimeContext.create(storage=self.storage)
+        self.ctx = RuntimeContext.create(storage=self.storage, mesh_spec=mesh_spec)
         self.host = host
         self.port = port
         self.engine_id = engine_id or variant.engine_factory

@@ -36,7 +36,9 @@ def test_make_mesh_2d_and_wildcard():
 
 def test_make_mesh_errors():
     with pytest.raises(ValueError, match="need"):
-        make_mesh({AXIS_DATA: 3})
+        make_mesh({AXIS_DATA: 16})  # oversubscribed
+    # Undersubscribed is fine: take a device prefix (`--mesh data=3`).
+    assert dict(make_mesh({AXIS_DATA: 3}).shape) == {AXIS_DATA: 3}
     with pytest.raises(ValueError, match="divisible"):
         make_mesh({AXIS_DATA: -1, AXIS_MODEL: 3})
     with pytest.raises(ValueError, match="one mesh axis"):

@@ -5,9 +5,17 @@
 // so the rebuild's native frontend owns the network path and AGGREGATES
 // in-flight requests into batches before crossing into the compiled model:
 //
-//   conn threads ──► pending queue ──► batcher thread ──► predict callback
-//        ▲                                (≤ max_batch, ≤ max_wait_us)
+//   worker threads ──► pending queue ──► batcher thread ──► predict callback
+//        ▲                                 (≤ max_batch, ≤ max_wait_us)
 //        └────────────── per-request response signal ◄─────────┘
+//
+// Concurrency model (round 2 — replaces thread-per-connection, which
+// accumulated one unjoined std::thread per request forever): a FIXED pool
+// of worker threads pulls accepted sockets from a queue and speaks
+// HTTP/1.1 with keep-alive, so a closed-loop client pays connection setup
+// once, not per request.  Shutdown drains both queues: queued sockets are
+// closed, queued Pending requests are failed with 503 so no worker is left
+// blocked on its condition variable (round-1 deadlock).
 //
 // The predict callback is registered from Python via ctypes (CFUNCTYPE —
 // ctypes acquires the GIL on entry); it receives an opaque batch handle and
@@ -23,6 +31,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cerrno>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -61,9 +70,14 @@ struct Frontend {
   std::atomic<bool> running{false};
   std::thread acceptor;
   std::thread batcher;
-  std::vector<std::thread> conns;
-  std::mutex conns_mu;
+  std::vector<std::thread> workers;
 
+  // accepted sockets awaiting a worker
+  std::deque<int> conn_queue;
+  std::mutex cmu;
+  std::condition_variable ccv;
+
+  // requests awaiting the batcher
   std::deque<Pending*> queue;
   std::mutex qmu;
   std::condition_variable qcv;
@@ -73,6 +87,7 @@ struct Frontend {
   std::atomic<uint64_t> n_errors{0};
   std::atomic<uint64_t> n_batches{0};
   std::atomic<uint64_t> batch_rows{0};
+  std::atomic<uint64_t> live_conns{0};
 };
 
 Frontend* g_frontend = nullptr;
@@ -86,30 +101,50 @@ void write_all(int fd, const char* data, size_t len) {
   }
 }
 
-void http_reply(int fd, int status, const char* ctype,
-                const std::string& body) {
+void http_reply(int fd, int status, const char* ctype, const std::string& body,
+                bool keep_alive) {
   const char* reason = status == 200   ? "OK"
                        : status == 201 ? "Created"
                        : status == 400 ? "Bad Request"
                        : status == 404 ? "Not Found"
+                       : status == 503 ? "Service Unavailable"
                                        : "Internal Server Error";
   char head[256];
   int n = snprintf(head, sizeof(head),
                    "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
-                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
-                   status, reason, ctype, body.size());
+                   "Content-Length: %zu\r\nConnection: %s\r\n\r\n",
+                   status, reason, ctype, body.size(),
+                   keep_alive ? "keep-alive" : "close");
   write_all(fd, head, n);
   write_all(fd, body.data(), body.size());
 }
 
-// Minimal HTTP/1.1 request reader: header block then Content-Length body.
-bool read_request(int fd, std::string& method, std::string& path,
-                  std::string& body) {
+// recv that tolerates the 250 ms SO_RCVTIMEO poll while `running`: an idle
+// keep-alive connection otherwise pins its worker in a blocking recv and
+// pio_frontend_stop joins forever.
+ssize_t recv_while_running(int fd, char* buf, size_t len,
+                           const std::atomic<bool>& running) {
+  for (;;) {
+    ssize_t r = ::recv(fd, buf, len, 0);
+    if (r >= 0) return r;
+    if ((errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) &&
+        running.load())
+      continue;
+    return -1;
+  }
+}
+
+// Minimal HTTP/1.1 request reader.  `carry` holds bytes of the NEXT
+// pipelined/keep-alive request that arrived with a previous read.
+bool read_request(int fd, std::string& carry, std::string& method,
+                  std::string& path, std::string& body, bool& want_close,
+                  const std::atomic<bool>& running) {
   std::string buf;
+  buf.swap(carry);
   char tmp[4096];
-  size_t header_end = std::string::npos;
+  size_t header_end = buf.find("\r\n\r\n");
   while (header_end == std::string::npos) {
-    ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+    ssize_t r = recv_while_running(fd, tmp, sizeof(tmp), running);
     if (r <= 0) return false;
     buf.append(tmp, r);
     header_end = buf.find("\r\n\r\n");
@@ -125,6 +160,8 @@ bool read_request(int fd, std::string& method, std::string& path,
   if (q != std::string::npos) path.resize(q);
 
   size_t content_length = 0;
+  want_close = false;
+  bool http10 = head.find("HTTP/1.0") != std::string::npos;
   size_t pos = 0;
   while (pos < head.size()) {
     size_t eol = head.find("\r\n", pos);
@@ -134,31 +171,42 @@ bool read_request(int fd, std::string& method, std::string& path,
       if (c >= 'A' && c <= 'Z') c += 32;
     if (line.rfind("content-length:", 0) == 0)
       content_length = strtoul(line.c_str() + 15, nullptr, 10);
+    if (line.rfind("connection:", 0) == 0) {
+      if (line.find("close") != std::string::npos) want_close = true;
+      if (http10 && line.find("keep-alive") != std::string::npos)
+        http10 = false;  // explicit keep-alive on 1.0
+    }
     pos = eol + 2;
   }
+  if (http10) want_close = true;  // HTTP/1.0 default: close
   if (content_length > (64u << 20)) return false;  // 64 MB cap
   body = buf.substr(header_end + 4);
   while (body.size() < content_length) {
-    ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+    ssize_t r = recv_while_running(fd, tmp, sizeof(tmp), running);
     if (r <= 0) return false;
     body.append(tmp, r);
   }
-  body.resize(content_length);
+  if (body.size() > content_length) {
+    carry = body.substr(content_length);  // start of the next request
+    body.resize(content_length);
+  }
   return true;
 }
 
-void handle_conn(Frontend* fe, int fd) {
+// Serve one request on an open connection.  Returns false when the
+// connection should close (error, Connection: close, or shutdown).
+bool handle_one(Frontend* fe, int fd, std::string& carry) {
   std::string method, path, body;
-  if (!read_request(fd, method, path, body)) {
-    ::close(fd);
-    return;
-  }
+  bool want_close = false;
+  if (!read_request(fd, carry, method, path, body, want_close, fe->running))
+    return false;
+  bool keep = !want_close;
   fe->n_requests++;
   if (method == "GET" && path == "/") {
     http_reply(fd, 200, "application/json",
-               "{\"status\":\"alive\",\"frontend\":\"native\"}");
+               "{\"status\":\"alive\",\"frontend\":\"native\"}", keep);
   } else if (method == "GET" && path == "/metrics") {
-    char m[512];
+    char m[640];
     uint64_t nb = fe->n_batches.load(), br = fe->batch_rows.load();
     snprintf(m, sizeof(m),
              "# TYPE pio_frontend_requests_total counter\n"
@@ -166,17 +214,32 @@ void handle_conn(Frontend* fe, int fd) {
              "pio_frontend_errors_total %llu\n"
              "# TYPE pio_frontend_batch_size gauge\n"
              "pio_frontend_batches_total %llu\n"
-             "pio_frontend_mean_batch_size %.3f\n",
+             "pio_frontend_mean_batch_size %.3f\n"
+             "pio_frontend_live_connections %llu\n",
              (unsigned long long)fe->n_requests.load(),
-             (unsigned long long)fe->n_errors.load(),
-             (unsigned long long)nb, nb ? (double)br / nb : 0.0);
-    http_reply(fd, 200, "text/plain; version=0.0.4", m);
+             (unsigned long long)fe->n_errors.load(), (unsigned long long)nb,
+             nb ? (double)br / nb : 0.0,
+             (unsigned long long)fe->live_conns.load());
+    http_reply(fd, 200, "text/plain; version=0.0.4", m, keep);
   } else if (method == "POST" && path == "/queries.json") {
     Pending p;
     p.body.swap(body);
+    bool queued = false;
     {
       std::lock_guard<std::mutex> lk(fe->qmu);
-      fe->queue.push_back(&p);
+      // Checked under qmu so shutdown's drain (also under qmu) can never
+      // miss a Pending: either we enqueue before the drain, or we observe
+      // running == false and 503 immediately.
+      if (fe->running.load()) {
+        fe->queue.push_back(&p);
+        queued = true;
+      }
+    }
+    if (!queued) {
+      fe->n_errors++;
+      http_reply(fd, 503, "application/json",
+                 "{\"message\":\"shutting down\"}", false);
+      return false;
     }
     fe->qcv.notify_one();
     {
@@ -184,11 +247,36 @@ void handle_conn(Frontend* fe, int fd) {
       p.cv.wait(lk, [&] { return p.done; });
     }
     if (p.status >= 400) fe->n_errors++;
-    http_reply(fd, p.status, "application/json; charset=UTF-8", p.response);
+    http_reply(fd, p.status, "application/json; charset=UTF-8", p.response,
+               keep);
   } else {
-    http_reply(fd, 404, "application/json", "{\"message\":\"Not Found\"}");
+    http_reply(fd, 404, "application/json", "{\"message\":\"Not Found\"}",
+               keep);
   }
-  ::close(fd);
+  return keep && fe->running.load();
+}
+
+void worker_loop(Frontend* fe) {
+  for (;;) {
+    int fd = -1;
+    {
+      std::unique_lock<std::mutex> lk(fe->cmu);
+      fe->ccv.wait(lk,
+                   [&] { return !fe->conn_queue.empty() || !fe->running; });
+      if (fe->conn_queue.empty()) {
+        if (!fe->running.load()) return;
+        continue;
+      }
+      fd = fe->conn_queue.front();
+      fe->conn_queue.pop_front();
+    }
+    fe->live_conns++;
+    std::string carry;
+    while (handle_one(fe, fd, carry)) {
+    }
+    ::close(fd);
+    fe->live_conns--;
+  }
 }
 
 void batcher_loop(Frontend* fe) {
@@ -202,8 +290,7 @@ void batcher_loop(Frontend* fe) {
       if (fe->queue.empty()) continue;
       // Continuous batching: take what's there, then linger briefly for
       // stragglers up to max_batch.
-      while (!fe->queue.empty() &&
-             (int)batch.items.size() < fe->max_batch) {
+      while (!fe->queue.empty() && (int)batch.items.size() < fe->max_batch) {
         batch.items.push_back(fe->queue.front());
         fe->queue.pop_front();
       }
@@ -211,9 +298,8 @@ void batcher_loop(Frontend* fe) {
         auto deadline = std::chrono::steady_clock::now() +
                         std::chrono::microseconds(fe->max_wait_us);
         while ((int)batch.items.size() < fe->max_batch &&
-               fe->qcv.wait_until(lk, deadline, [&] {
-                 return !fe->queue.empty();
-               })) {
+               fe->qcv.wait_until(lk, deadline,
+                                  [&] { return !fe->queue.empty(); })) {
           while (!fe->queue.empty() &&
                  (int)batch.items.size() < fe->max_batch) {
             batch.items.push_back(fe->queue.front());
@@ -237,6 +323,20 @@ void batcher_loop(Frontend* fe) {
       p->cv.notify_one();
     }
   }
+  // Shutdown drain: anything still queued (or racing in under qmu) gets a
+  // definite answer so its worker never blocks forever on p->cv.
+  std::deque<Pending*> rest;
+  {
+    std::lock_guard<std::mutex> lk(fe->qmu);
+    rest.swap(fe->queue);
+  }
+  for (Pending* p : rest) {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->status = 503;
+    p->response = "{\"message\":\"shutting down\"}";
+    p->done = true;
+    p->cv.notify_one();
+  }
 }
 
 void acceptor_loop(Frontend* fe) {
@@ -250,8 +350,15 @@ void acceptor_loop(Frontend* fe) {
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::lock_guard<std::mutex> lk(fe->conns_mu);
-    fe->conns.emplace_back(handle_conn, fe, fd);
+    // Bounded recv so workers re-check `running` while a keep-alive
+    // connection idles (shutdown liveness, not a request deadline).
+    timeval tv{0, 250 * 1000};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    {
+      std::lock_guard<std::mutex> lk(fe->cmu);
+      fe->conn_queue.push_back(fd);
+    }
+    fe->ccv.notify_one();
   }
 }
 
@@ -287,6 +394,15 @@ int pio_frontend_start(const char* host, int port, int max_batch,
   getsockname(fe->listen_fd, (sockaddr*)&addr, &alen);
   fe->port = ntohs(addr.sin_port);
   fe->running = true;
+  // Worker pool bounds concurrent in-flight requests; sized past max_batch
+  // so the batcher can actually fill a batch from concurrent clients.
+  unsigned hw = std::thread::hardware_concurrency();
+  int n_workers = (int)(hw ? hw * 4 : 16);
+  if (n_workers < fe->max_batch) n_workers = fe->max_batch;
+  if (n_workers > 128) n_workers = 128;
+  fe->workers.reserve(n_workers);
+  for (int i = 0; i < n_workers; i++)
+    fe->workers.emplace_back(worker_loop, fe);
   fe->batcher = std::thread(batcher_loop, fe);
   fe->acceptor = std::thread(acceptor_loop, fe);
   g_frontend = fe;
@@ -319,14 +435,18 @@ void pio_frontend_stop() {
   fe->running = false;
   ::shutdown(fe->listen_fd, SHUT_RDWR);
   ::close(fe->listen_fd);
-  fe->qcv.notify_all();
+  fe->qcv.notify_all();  // wake batcher → it drains + 503s leftovers
   if (fe->acceptor.joinable()) fe->acceptor.join();
   if (fe->batcher.joinable()) fe->batcher.join();
+  // Close sockets no worker picked up, then release the pool.
   {
-    std::lock_guard<std::mutex> lk(fe->conns_mu);
-    for (auto& t : fe->conns)
-      if (t.joinable()) t.join();
+    std::lock_guard<std::mutex> lk(fe->cmu);
+    for (int fd : fe->conn_queue) ::close(fd);
+    fe->conn_queue.clear();
   }
+  fe->ccv.notify_all();
+  for (auto& t : fe->workers)
+    if (t.joinable()) t.join();
   g_frontend = nullptr;
   delete fe;
 }

@@ -580,12 +580,12 @@ def build_parser() -> argparse.ArgumentParser:
     bp.set_defaults(fn=cmd_batchpredict)
 
     adm = sub.add_parser("adminserver", help="app-management REST API server")
-    adm.add_argument("--ip", default="0.0.0.0")
+    adm.add_argument("--ip", default="127.0.0.1")
     adm.add_argument("--port", type=int, default=7071)
     adm.set_defaults(fn=cmd_adminserver)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
-    db.add_argument("--ip", default="0.0.0.0")
+    db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
     db.set_defaults(fn=cmd_dashboard)
 

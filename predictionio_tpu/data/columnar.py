@@ -1,0 +1,99 @@
+"""Vectorized Arrow-native transforms for the P training path.
+
+Reference: the reference's RDD path (SURVEY.md §2.1 "User-facing stores")
+keeps event data distributed/columnar from storage scan to trainer input.
+Round 1's templates broke that by `.to_pylist()` + per-row ``json.loads``
+over every event — a Python loop that walls out long before the ML-25M
+north star.  These helpers keep everything in Arrow/numpy kernels:
+
+- ``encode_ids``: dictionary-encode an id column → dense int codes + the
+  :class:`BiMap` over *unique* ids (Arrow assigns dictionary codes in
+  first-appearance order, matching ``BiMap.string_int`` semantics).
+- ``numeric_property``: extract one numeric property from the
+  ``properties_json`` column with an Arrow regex kernel — C speed, no
+  JSON parse.  Sound for numbers because ``DataMap`` serializes via
+  ``json.dumps`` (numbers appear as bare literals); not usable for
+  string/nested values, which keep the slow path.
+- ``event_mask``: boolean numpy mask for event-name membership.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from predictionio_tpu.data.event import BiMap
+
+__all__ = ["encode_ids", "numeric_property", "event_mask"]
+
+_ColumnLike = Union[pa.Array, pa.ChunkedArray]
+
+
+def _as_array(col: _ColumnLike) -> pa.Array:
+    if isinstance(col, pa.ChunkedArray):
+        return col.combine_chunks()
+    return col
+
+
+def encode_ids(col: _ColumnLike) -> Tuple[np.ndarray, BiMap]:
+    """Id strings → (dense int64 codes, BiMap) without touching Python rows.
+
+    The BiMap is built from the *dictionary* (one entry per unique id), so
+    cost scales with unique entities, not events.
+    """
+    d = _as_array(col).dictionary_encode()
+    codes = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+    keys = d.dictionary.to_pylist()
+    return codes, BiMap({k: i for i, k in enumerate(keys)})
+
+
+def numeric_property(
+    table_or_col: Union[pa.Table, _ColumnLike],
+    key: str,
+    default: float = 0.0,
+) -> np.ndarray:
+    """Extract a numeric property per event as float64, ``default`` where
+    absent/null.  One Arrow regex kernel over the JSON column."""
+    col = (table_or_col.column("properties_json")
+           if isinstance(table_or_col, pa.Table) else table_or_col)
+    arr = _as_array(col)
+    if len(arr) == 0:
+        return np.empty(0, dtype=np.float64)
+    # json.dumps emits numbers bare: "key": -1.5e3, — capture to , } or ].
+    pattern = '"' + re.escape(key) + '"\\s*:\\s*(?P<v>-?[0-9][0-9eE+\\-.]*)'
+    hit = pc.extract_regex(pc.fill_null(arr, ""), pattern=pattern)
+    vals = pc.struct_field(hit, "v")
+    nums = pc.cast(vals, pa.float64())
+    return pc.fill_null(nums, default).to_numpy(zero_copy_only=False)
+
+
+def bool_property(
+    table_or_col: Union[pa.Table, _ColumnLike],
+    key: str,
+) -> np.ndarray:
+    """True where property ``key`` is JSON ``true`` or ``1`` — one regex
+    kernel (json.dumps emits booleans as bare ``true``/``false``)."""
+    col = (table_or_col.column("properties_json")
+           if isinstance(table_or_col, pa.Table) else table_or_col)
+    arr = _as_array(col)
+    if len(arr) == 0:
+        return np.empty(0, dtype=bool)
+    pattern = '"' + re.escape(key) + '"\\s*:\\s*(true|1(?:\\.0*)?)([,}\\s]|$)'
+    return pc.match_substring_regex(
+        pc.fill_null(arr, ""), pattern
+    ).to_numpy(zero_copy_only=False)
+
+
+def event_mask(
+    table: pa.Table,
+    names: Sequence[str],
+    column: str = "event",
+) -> np.ndarray:
+    """Boolean mask of rows whose event name is in ``names``."""
+    return pc.is_in(
+        table.column(column), value_set=pa.array(list(names))
+    ).to_numpy(zero_copy_only=False)

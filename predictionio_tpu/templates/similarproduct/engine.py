@@ -90,19 +90,21 @@ class SimilarProductDataSource(DataSource):
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
             event_names=list(p.eventNames))
-        users = table.column("entity_id").to_pylist()
-        items = table.column("target_entity_id").to_pylist()
+        from predictionio_tpu.data.columnar import encode_ids
+
+        user_ids, user_index = encode_ids(table.column("entity_id"))
+        item_ids, item_index = encode_ids(table.column("target_entity_id"))
+        # Item categories come from $set aggregation — per-ENTITY state
+        # (small), not per-event, so the dict path is fine here.
         props = ctx.event_store.aggregate_properties(p.appName, "item")
         cats: Dict[str, Set[str]] = {}
         for item, pm in props.items():
             c = pm.get("categories")
             if c:
                 cats[item] = set(c)
-        user_index = BiMap.string_int(users)
-        item_index = BiMap.string_int(items)
         return ViewData(
-            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
-            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
+            user_ids=user_ids,
+            item_ids=item_ids,
             user_index=user_index,
             item_index=item_index,
             item_categories=cats,

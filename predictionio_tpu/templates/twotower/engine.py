@@ -80,13 +80,13 @@ class TwoTowerDataSource(DataSource):
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
             event_names=list(p.eventNames))
-        users = table.column("entity_id").to_pylist()
-        items = table.column("target_entity_id").to_pylist()
-        user_index = BiMap.string_int(users)
-        item_index = BiMap.string_int(items)
+        from predictionio_tpu.data.columnar import encode_ids
+
+        user_ids, user_index = encode_ids(table.column("entity_id"))
+        item_ids, item_index = encode_ids(table.column("target_entity_id"))
         return InteractionData(
-            user_ids=np.array([user_index[u] for u in users], dtype=np.int64),
-            item_ids=np.array([item_index[i] for i in items], dtype=np.int64),
+            user_ids=user_ids,
+            item_ids=item_ids,
             user_index=user_index,
             item_index=item_index,
         )

@@ -60,8 +60,12 @@ def _numpy_als_side(indices_per_row, vals_per_row, y, reg, implicit, alpha):
 def test_single_step_matches_oracle(implicit):
     users, items, ratings = _toy()
     n_users, n_items = 30, 20
+    # gram_dtype f32: this test checks the math against a float64 oracle
+    # at tight tolerance; the bf16 speed default is covered by the
+    # convergence tests below.
     cfg = ALSConfig(rank=4, iterations=1, reg=0.1, alpha=2.0,
-                    implicit=implicit, seed=7, bucket_bounds=(4, 8))
+                    implicit=implicit, seed=7, bucket_bounds=(4, 8),
+                    gram_dtype="float32")
     model = train_als(users, items, ratings, n_users, n_items, cfg)
 
     # Re-derive the expected first-iteration factors with numpy.
@@ -133,3 +137,71 @@ def test_predict_scores_shape():
     s = predict_scores(model.user_factors, model.item_factors,
                        jnp.asarray([0, 1]), jnp.asarray([3, 4]))
     assert s.shape == (2,)
+
+
+def test_split_above_matches_unsplit():
+    """Segment-summed split path == plain path (exact, not approximate)."""
+    users, items, ratings = _toy(density=0.8)
+    base = dict(rank=4, iterations=3, reg=0.05, seed=11, gram_dtype="float32",
+                bucket_bounds=(4,))
+    m_plain = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=None))
+    m_split = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=8))
+    np.testing.assert_allclose(np.asarray(m_plain.user_factors),
+                               np.asarray(m_split.user_factors),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(m_plain.item_factors),
+                               np.asarray(m_split.item_factors),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_split_above_matches_unsplit_on_mesh():
+    users, items, ratings = _toy(density=0.8)
+    base = dict(rank=4, iterations=2, reg=0.05, seed=11, gram_dtype="float32",
+                bucket_bounds=(4,))
+    mesh = make_mesh({"data": 8})
+    m_plain = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=None))
+    m_split = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=8), mesh=mesh)
+    np.testing.assert_allclose(np.asarray(m_plain.user_factors),
+                               np.asarray(m_split.user_factors),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_degree_zero_entities_get_near_zero_factors():
+    """Pinned semantics (VERDICT.md weak-5): unrated entities solve to the
+    ridge solution of an empty system — (lambda I) x = 0 -> x = 0 — so they
+    never outrank real recommendations (MLlib simply omits them; scoring
+    behavior matches: 0-dot = 0)."""
+    users = np.array([0, 0, 1, 1, 2])
+    items = np.array([0, 1, 0, 2, 1])
+    ratings = np.ones(5, dtype=np.float32)
+    # users 3, 4 and item 3 have no ratings at all
+    model = train_als(users, items, ratings, 5, 4,
+                      ALSConfig(rank=4, iterations=2, reg=0.1, seed=0))
+    uf = np.asarray(model.user_factors)
+    assert np.abs(uf[3:]).max() < 1e-5
+    assert np.abs(np.asarray(model.item_factors)[3]).max() < 1e-5
+    # rated rows are non-trivial
+    assert np.abs(uf[:3]).max() > 1e-2
+
+
+def test_split_chunking_matches_unsplit():
+    """HBM chunking of split buckets (entity-boundary cuts) stays exact."""
+    users, items, ratings = _toy(density=0.9)
+    base = dict(rank=4, iterations=3, reg=0.05, seed=13, gram_dtype="float32",
+                bucket_bounds=(4,))
+    m_plain = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=None))
+    # max_block_floats tiny -> every split bucket is forced into chunks.
+    m_chunk = train_als(users, items, ratings, 30, 20,
+                        ALSConfig(**base, split_above=4,
+                                  max_block_floats=4 * 4 * 8))
+    np.testing.assert_allclose(np.asarray(m_plain.user_factors),
+                               np.asarray(m_chunk.user_factors),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(m_plain.item_factors),
+                               np.asarray(m_chunk.item_factors),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,120 @@
+"""Columnar P-path transforms: correctness vs the slow-path oracle + scale.
+
+VERDICT.md round-1 item 4: the template DataSources must stop doing
+per-event ``json.loads`` loops.  These tests pin the Arrow-kernel helpers
+against a row-by-row oracle and prove the read path is loop-free at scale.
+"""
+
+import json
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from predictionio_tpu.data.columnar import (
+    bool_property,
+    encode_ids,
+    event_mask,
+    numeric_property,
+)
+
+
+def _table(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    users = [f"u{int(x)}" for x in rng.integers(0, 50, n)]
+    events = rng.choice(["rate", "buy", "view"], n).tolist()
+    props = []
+    for i in range(n):
+        if events[i] == "rate":
+            props.append(json.dumps({"rating": float(rng.integers(1, 11)) / 2,
+                                     "clicked": bool(rng.random() < 0.5)}))
+        elif events[i] == "buy":
+            props.append(json.dumps({"clicked": True}))
+        else:
+            props.append(None)
+    return pa.table({"entity_id": users, "event": events,
+                     "properties_json": props})
+
+
+class TestEncodeIds:
+    def test_matches_first_seen_order(self):
+        t = _table(400)
+        codes, bimap = encode_ids(t.column("entity_id"))
+        rows = t.column("entity_id").to_pylist()
+        # Oracle: BiMap.string_int semantics (first-seen contiguous ints).
+        seen = {}
+        for r in rows:
+            seen.setdefault(r, len(seen))
+        assert dict(zip(bimap, (bimap[k] for k in bimap))) == seen
+        np.testing.assert_array_equal(codes, [seen[r] for r in rows])
+
+    def test_chunked_input(self):
+        t1, t2 = _table(100, seed=1), _table(100, seed=2)
+        chunked = pa.chunked_array([t1.column("entity_id").combine_chunks(),
+                                    t2.column("entity_id").combine_chunks()])
+        codes, bimap = encode_ids(chunked)
+        assert len(codes) == 200
+        rows = chunked.to_pylist()
+        assert all(bimap.inverse[c] == r for c, r in zip(codes[:20], rows[:20]))
+
+
+class TestProperties:
+    def test_numeric_matches_json_loads(self):
+        t = _table(500)
+        got = numeric_property(t, "rating", default=-1.0)
+        for i, pr in enumerate(t.column("properties_json").to_pylist()):
+            want = json.loads(pr).get("rating", -1.0) if pr else -1.0
+            assert got[i] == pytest.approx(want), i
+
+    def test_numeric_handles_exponents_and_negatives(self):
+        props = [json.dumps({"x": v}) for v in (-1.5, 2e3, 0.5, -3e-2, 7)]
+        t = pa.table({"properties_json": props})
+        np.testing.assert_allclose(numeric_property(t, "x"),
+                                   [-1.5, 2e3, 0.5, -3e-2, 7])
+
+    def test_bool_matches_json_loads(self):
+        t = _table(500)
+        got = bool_property(t, "clicked")
+        for i, pr in enumerate(t.column("properties_json").to_pylist()):
+            want = bool(pr and json.loads(pr).get("clicked") in (True, 1, 1.0))
+            assert bool(got[i]) == want, (i, pr)
+
+    def test_key_is_regex_escaped(self):
+        t = pa.table({"properties_json": [json.dumps({"a.b": 3.0,
+                                                      "axb": 9.0})]})
+        np.testing.assert_allclose(numeric_property(t, "a.b"), [3.0])
+
+
+class TestEventMask:
+    def test_mask(self):
+        t = _table(300)
+        got = event_mask(t, ["rate", "buy"])
+        want = [e in ("rate", "buy") for e in t.column("event").to_pylist()]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_scale_smoke():
+    """2M events through the full columnar transform stack in seconds —
+    the loop-free guarantee the ML-25M north star depends on."""
+    n = 2_000_000
+    rng = np.random.default_rng(7)
+    users = pa.array((rng.integers(0, 160_000, n)).astype(str))
+    items = pa.array((rng.integers(0, 59_000, n)).astype(str))
+    ratings_str = [f'{{"rating": {r}}}' for r in (0.5, 1.5, 3.0, 4.5, 5.0)]
+    props = pa.array(np.array(ratings_str, dtype=object)[
+        rng.integers(0, 5, n)].tolist())
+    events = pa.array(np.array(["rate", "buy"], dtype=object)[
+        rng.integers(0, 2, n)].tolist())
+    t = pa.table({"entity_id": users, "target_entity_id": items,
+                  "event": events, "properties_json": props})
+    t0 = time.perf_counter()
+    ucodes, uindex = encode_ids(t.column("entity_id"))
+    icodes, _ = encode_ids(t.column("target_entity_id"))
+    vals = numeric_property(t, "rating", default=0.0)
+    mask = event_mask(t, ["rate"])
+    dt = time.perf_counter() - t0
+    assert len(ucodes) == n and len(vals) == n and mask.sum() > 0
+    assert len(uindex) <= 160_000
+    # Generous bound: the round-1 loop took minutes at this size.
+    assert dt < 20.0, f"columnar transforms too slow: {dt:.1f}s"

@@ -174,3 +174,139 @@ class TestNativeFrontend:
             assert "pio_frontend_requests_total" in text
         finally:
             fe.stop()
+
+
+@needs_native
+class TestFrontendRound2:
+    """Round-2 fixes: worker pool (no thread growth), keep-alive, shutdown
+    drain (ADVICE.md mediums 1-2, VERDICT.md weak-3)."""
+
+    def _thread_count(self):
+        import os
+
+        return len(os.listdir("/proc/self/task"))
+
+    def test_keep_alive_reuses_connection(self):
+        import http.client
+
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(lambda batch: [{"ok": True} for _ in batch],
+                            host="127.0.0.1", port=0, max_batch=8,
+                            max_wait_us=100)
+        port = fe.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            for i in range(50):  # one TCP connection, many requests
+                conn.request("POST", "/queries.json",
+                             body=json.dumps({"i": i}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert json.loads(resp.read()) == {"ok": True}
+                assert resp.getheader("Connection") == "keep-alive"
+            conn.close()
+        finally:
+            fe.stop()
+
+    def test_thread_count_flat_under_load(self):
+        import http.client
+
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(lambda batch: [{"ok": True} for _ in batch],
+                            host="127.0.0.1", port=0, max_batch=16,
+                            max_wait_us=100)
+        port = fe.start()
+        try:
+            def hammer(n):
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+                for i in range(n):
+                    conn.request("POST", "/queries.json",
+                                 body=json.dumps({"i": i}))
+                    r = conn.getresponse()
+                    assert r.status == 200
+                    r.read()
+                conn.close()
+
+            hammer(20)
+            before = self._thread_count()
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                list(ex.map(hammer, [250] * 8))  # 2000 requests
+            after = self._thread_count()
+            # Round 1 grew one C++ thread per request (would be ~+2000).
+            assert after - before <= 8, (before, after)
+        finally:
+            fe.stop()
+
+    def test_stop_with_queued_requests_does_not_hang(self):
+        import threading
+        import time as _t
+
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        release = threading.Event()
+
+        def slow_handler(batch):
+            release.wait(timeout=15)
+            return [{"ok": True} for _ in batch]
+
+        fe = NativeFrontend(slow_handler, host="127.0.0.1", port=0,
+                            max_batch=1, max_wait_us=0)
+        port = fe.start()
+
+        statuses = []
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    statuses.append(r.status)
+            except urllib.error.HTTPError as e:
+                statuses.append(e.code)
+            except Exception:
+                statuses.append(-1)
+
+        threads = [threading.Thread(target=post) for _ in range(6)]
+        for t in threads:
+            t.start()
+        _t.sleep(0.3)  # first request in callback, rest queued
+        release.set()
+        stopper = threading.Thread(target=fe.stop)
+        stopper.start()
+        stopper.join(timeout=10)
+        assert not stopper.is_alive(), "pio_frontend_stop hung (round-1 bug)"
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+        # Every client got SOME definitive answer (200 or 503), none hung.
+        assert len(statuses) == 6
+        assert all(s in (200, 503, -1) for s in statuses)
+
+    def test_stop_with_idle_keepalive_connection(self):
+        """A worker parked in recv on an idle keep-alive socket must not
+        pin pio_frontend_stop (SO_RCVTIMEO poll + running check)."""
+        import http.client
+        import threading
+        import time as _t
+
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(lambda b: [{"ok": True} for _ in b],
+                            host="127.0.0.1", port=0, max_batch=4,
+                            max_wait_us=100)
+        port = fe.start()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/queries.json", body=json.dumps({}))
+        conn.getresponse().read()
+        # Connection left OPEN and idle.
+        stopper = threading.Thread(target=fe.stop)
+        t0 = _t.perf_counter()
+        stopper.start()
+        stopper.join(timeout=5)
+        alive = stopper.is_alive()
+        conn.close()
+        assert not alive, "stop() hung on idle keep-alive connection"
+        assert _t.perf_counter() - t0 < 5

@@ -61,6 +61,46 @@ class TestPadRagged:
                 got = set(b.indices[r_local][b.mask[r_local]].tolist())
                 assert got == expect
 
+    def test_split_above_partials_cover_exactly(self):
+        """Zipf-head splitting: partial rows jointly hold every entry once."""
+        rng = np.random.default_rng(1)
+        n_rows = 12
+        lens = np.concatenate([rng.integers(1, 6, 10), [40, 97]])
+        rows = np.repeat(np.arange(n_rows), lens)
+        cols = rng.integers(0, 50, rows.shape[0])
+        vals = rng.standard_normal(rows.shape[0]).astype(np.float32)
+        buckets = bucket_by_length(rows, cols, vals, n_rows,
+                                   bucket_bounds=(8,), split_above=16)
+        split = [b for b in buckets if b.split]
+        assert len(split) == 1
+        sb = split[0]
+        assert sb.shape[1] == 16  # partial rows capped at split_above
+        # Entities 10 (len 40 -> 3 partials) and 11 (len 97 -> 7 partials).
+        for ent, exp_parts in ((10, 3), (11, 7)):
+            part_rows = np.where(sb.row_ids == ent)[0]
+            assert len(part_rows) == exp_parts
+            got = sb.indices[part_rows][sb.mask[part_rows]]
+            np.testing.assert_array_equal(np.sort(got),
+                                          np.sort(cols[rows == ent]))
+        # seg_ids map partials of one entity to one slot; ent_ids invert it.
+        for ent in (10, 11):
+            slots = set(sb.seg_ids[sb.row_ids == ent].tolist())
+            assert len(slots) == 1
+            assert sb.ent_ids[slots.pop()] == ent
+        # Non-split buckets cover the small entities.
+        seen = np.concatenate([b.row_ids[b.row_ids >= 0] for b in buckets
+                               if not b.split])
+        assert sorted(seen.tolist()) == list(range(10))
+
+    def test_split_above_row_padding(self):
+        rows = np.repeat([0], 33)
+        cols = np.arange(33)
+        buckets = bucket_by_length(rows, cols, None, 1, bucket_bounds=(8,),
+                                   split_above=8, pad_rows_to=4)
+        sb = [b for b in buckets if b.split][0]
+        assert sb.shape[0] % 4 == 0 and len(sb.ent_ids) % 4 == 0
+        assert int(sb.mask.sum()) == 33
+
 
 class TestLinalg:
     def test_ridge_solve_matches_numpy(self):

@@ -115,9 +115,10 @@ class TestEventServer:
         ev = {"event": "view", "entityType": "user", "entityId": "u9"}
         s, _ = _req("POST", f"{base}/events.json?accessKey={key}&channel=mobile", ev)
         assert s == 201
-        # Default channel read does NOT see it; channel read does.
-        s, _ = _req("GET", f"{base}/events.json?accessKey={key}&entityId=u9")
-        assert s == 404
+        # Default channel read does NOT see it (empty match = 200 []);
+        # channel read does.
+        s, none = _req("GET", f"{base}/events.json?accessKey={key}&entityId=u9")
+        assert s == 200 and none == []
         s, found = _req(
             "GET", f"{base}/events.json?accessKey={key}&entityId=u9&channel=mobile")
         assert s == 200 and len(found) == 1

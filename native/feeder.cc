@@ -7,28 +7,40 @@
 // from worker threads so the Python/JAX process never blocks on batch
 // assembly: the feeder fills pinned buffers while the device runs step N.
 //
-// File format "PIOF1" (little-endian):
+// File format "PIOF1" (little-endian), version 2:
 //   0:  char[5] magic "PIOF1"
 //   5:  u8      pad
-//   6:  u16     version (=1)
+//   6:  u16     version (=2)
 //   8:  u64     n_rows
-//   16: u32[n]  user ids
+//   16: u32     n_extra   (extra f32 feature columns, e.g. DLRM dense)
+//   20: u32     pad
+//   24: u32[n]  user ids
 //   ...:u32[n]  item ids
 //   ...:f32[n]  values
-//   ...:i64[n]  event_time_us
+//   ...:<pad to 8-byte boundary>
+//   ...:i64[n]  event_time_us            (8-byte aligned by construction)
+//   ...:f32[n] x n_extra feature columns (column-major: col0 rows, col1...)
 //
-// C API (consumed via ctypes from predictionio_tpu/data/feeder.py):
+// Version 1 files (no n_extra field, data at offset 16, times potentially
+// only 4-byte aligned when n is odd) are still readable: their times are
+// copied via memcpy, never dereferenced as int64* (the round-1 layout made
+// misaligned loads UB on strict-alignment targets).
+//
+// C API (consumed via ctypes from predictionio_tpu/native/feeder.py):
 //   void*  pio_feeder_open(const char* path, uint64_t seed, int shuffle);
 //   int64  pio_feeder_num_rows(void*);
-//   int    pio_feeder_next_batch(void*, int64 batch, uint32* users,
-//                                uint32* items, float* vals, int64* times);
+//   int32  pio_feeder_n_extra(void*);
+//   int64  pio_feeder_next_batch(void*, int64 batch, uint32* users,
+//                                uint32* items, float* vals, int64* times,
+//                                float* extras /* [batch, n_extra] row-major,
+//                                                 may be null */);
 //        -> rows written (== batch unless epoch end; 0 = epoch boundary,
 //           next call starts the re-shuffled next epoch)
 //   void   pio_feeder_close(void*);
 //
-// Shuffling uses a per-epoch Fisher-Yates permutation under a 64-bit
-// SplitMix/Xoshiro generator — deterministic given (seed, epoch), matching
-// the Python loop's resume contract.
+// Shuffling uses a per-epoch Fisher-Yates permutation under a SplitMix64
+// generator — deterministic given (seed, epoch), matching the Python
+// loop's resume contract.
 
 #include <atomic>
 #include <cstdint>
@@ -61,10 +73,12 @@ struct Feeder {
   size_t map_len = 0;
   const uint8_t* base = nullptr;
   uint64_t n_rows = 0;
+  uint32_t n_extra = 0;
   const uint32_t* users = nullptr;
   const uint32_t* items = nullptr;
   const float* vals = nullptr;
-  const int64_t* times = nullptr;
+  const uint8_t* times_raw = nullptr;  // memcpy-read (v1 may be unaligned)
+  std::vector<const float*> extras;
 
   uint64_t seed = 0;
   bool shuffle = true;
@@ -87,6 +101,8 @@ struct Feeder {
   }
 };
 
+size_t align8(size_t x) { return (x + 7) & ~size_t(7); }
+
 }  // namespace
 
 extern "C" {
@@ -105,14 +121,25 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
     return nullptr;
   }
   const uint8_t* base = static_cast<const uint8_t*>(m);
-  if (memcmp(base, "PIOF1", 5) != 0) {
+  uint16_t version = 0;
+  memcpy(&version, base + 6, 2);
+  if (memcmp(base, "PIOF1", 5) != 0 || (version != 1 && version != 2)) {
     munmap(m, st.st_size);
     ::close(fd);
     return nullptr;
   }
   uint64_t n;
   memcpy(&n, base + 8, 8);
-  const size_t need = 16 + n * (4 + 4 + 4 + 8);
+  uint32_t n_extra = 0;
+  size_t data_off = 16;
+  if (version == 2) {
+    memcpy(&n_extra, base + 16, 4);
+    data_off = 24;
+  }
+  const size_t vals_end = data_off + n * 12;
+  const size_t times_off = version == 2 ? align8(vals_end) : vals_end;
+  const size_t extras_off = times_off + n * 8;
+  const size_t need = extras_off + size_t(n_extra) * n * 4;
   if (static_cast<size_t>(st.st_size) < need) {
     munmap(m, st.st_size);
     ::close(fd);
@@ -128,10 +155,14 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
   f->map_len = st.st_size;
   f->base = base;
   f->n_rows = n;
-  f->users = reinterpret_cast<const uint32_t*>(base + 16);
-  f->items = reinterpret_cast<const uint32_t*>(base + 16 + n * 4);
-  f->vals = reinterpret_cast<const float*>(base + 16 + n * 8);
-  f->times = reinterpret_cast<const int64_t*>(base + 16 + n * 12);
+  f->n_extra = n_extra;
+  f->users = reinterpret_cast<const uint32_t*>(base + data_off);
+  f->items = reinterpret_cast<const uint32_t*>(base + data_off + n * 4);
+  f->vals = reinterpret_cast<const float*>(base + data_off + n * 8);
+  f->times_raw = base + times_off;
+  for (uint32_t c = 0; c < n_extra; ++c)
+    f->extras.push_back(
+        reinterpret_cast<const float*>(base + extras_off + size_t(c) * n * 4));
   f->seed = seed;
   f->shuffle = shuffle != 0;
   f->reshuffle();
@@ -142,8 +173,13 @@ int64_t pio_feeder_num_rows(void* h) {
   return h ? static_cast<int64_t>(static_cast<Feeder*>(h)->n_rows) : -1;
 }
 
+int32_t pio_feeder_n_extra(void* h) {
+  return h ? static_cast<int32_t>(static_cast<Feeder*>(h)->n_extra) : -1;
+}
+
 int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
-                              uint32_t* items, float* vals, int64_t* times) {
+                              uint32_t* items, float* vals, int64_t* times,
+                              float* extras) {
   if (!h || batch <= 0) return -1;
   auto* f = static_cast<Feeder*>(h);
   std::lock_guard<std::mutex> lk(f->mu);
@@ -155,12 +191,17 @@ int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
   }
   const uint64_t take =
       std::min<uint64_t>(batch, f->n_rows - f->cursor);
+  const uint32_t ne = f->n_extra;
   for (uint64_t i = 0; i < take; ++i) {
     const uint64_t r = f->perm[f->cursor + i];
     users[i] = f->users[r];
     items[i] = f->items[r];
     if (vals) vals[i] = f->vals[r];
-    if (times) times[i] = f->times[r];
+    if (times)  // memcpy: v1 files may have this column 4-byte aligned only
+      memcpy(&times[i], f->times_raw + r * 8, 8);
+    if (extras)
+      for (uint32_t c = 0; c < ne; ++c)
+        extras[i * ne + c] = f->extras[c][r];
   }
   f->cursor += take;
   return static_cast<int64_t>(take);

@@ -58,6 +58,11 @@ using BatchCb = void (*)(void* batch_handle, int n);
 
 struct Batch {
   std::vector<Pending*> items;
+  // Set by pio_batch_respond (same thread: the callback runs synchronously
+  // inside this batch's batcher thread).  A responded Pending may be
+  // DESTROYED by its worker the moment respond() releases p->mu — the
+  // batcher must never touch it again, so doneness lives here, not in p.
+  std::vector<char> responded;
 };
 
 struct Frontend {
@@ -65,11 +70,17 @@ struct Frontend {
   int port = 0;
   int max_batch = 8;
   int max_wait_us = 2000;
+  int n_batchers = 4;
   BatchCb cb = nullptr;
 
   std::atomic<bool> running{false};
   std::thread acceptor;
-  std::thread batcher;
+  // Batcher POOL: each thread forms a batch and drives the Python callback
+  // independently, so several batches are in flight at once — parse,
+  // predict, and response writes overlap.  (Round 2 ran ONE batcher whose
+  // synchronous callback serialized the whole server; it measured SLOWER
+  // than the stdlib Python server.)
+  std::vector<std::thread> batchers;
   std::vector<std::thread> workers;
 
   // accepted sockets awaiting a worker
@@ -310,21 +321,27 @@ void batcher_loop(Frontend* fe) {
     }
     fe->n_batches++;
     fe->batch_rows += batch.items.size();
+    batch.responded.assign(batch.items.size(), 0);
     if (fe->cb) {
       fe->cb(&batch, (int)batch.items.size());  // → Python (GIL via ctypes)
     }
-    for (Pending* p : batch.items) {
+    // Only UNRESPONDED items may be touched here (their workers are still
+    // parked on p->cv; responded Pendings may already be destroyed).
+    for (size_t i = 0; i < batch.items.size(); i++) {
+      if (batch.responded[i]) continue;
+      Pending* p = batch.items[i];
       std::lock_guard<std::mutex> lk(p->mu);
-      if (!p->done) {  // callback forgot one — fail it, never hang the client
-        p->status = 500;
-        p->response = "{\"message\":\"no response produced\"}";
-        p->done = true;
-      }
+      p->status = 500;
+      p->response = "{\"message\":\"no response produced\"}";
+      p->done = true;
       p->cv.notify_one();
     }
   }
-  // Shutdown drain: anything still queued (or racing in under qmu) gets a
-  // definite answer so its worker never blocks forever on p->cv.
+}
+
+// Shutdown drain (called once after ALL batchers joined): anything still
+// queued gets a definite answer so its worker never blocks on p->cv.
+void drain_queue(Frontend* fe) {
   std::deque<Pending*> rest;
   {
     std::lock_guard<std::mutex> lk(fe->qmu);
@@ -367,11 +384,12 @@ void acceptor_loop(Frontend* fe) {
 extern "C" {
 
 int pio_frontend_start(const char* host, int port, int max_batch,
-                       int max_wait_us, BatchCb cb) {
+                       int max_wait_us, int n_batchers, BatchCb cb) {
   if (g_frontend) return -1;
   auto* fe = new Frontend();
   fe->max_batch = max_batch > 0 ? max_batch : 8;
   fe->max_wait_us = max_wait_us;
+  fe->n_batchers = n_batchers > 0 ? n_batchers : 4;
   fe->cb = cb;
   fe->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fe->listen_fd < 0) {
@@ -403,7 +421,9 @@ int pio_frontend_start(const char* host, int port, int max_batch,
   fe->workers.reserve(n_workers);
   for (int i = 0; i < n_workers; i++)
     fe->workers.emplace_back(worker_loop, fe);
-  fe->batcher = std::thread(batcher_loop, fe);
+  fe->batchers.reserve(fe->n_batchers);
+  for (int i = 0; i < fe->n_batchers; i++)
+    fe->batchers.emplace_back(batcher_loop, fe);
   fe->acceptor = std::thread(acceptor_loop, fe);
   g_frontend = fe;
   return fe->port;
@@ -423,10 +443,14 @@ void pio_batch_respond(void* batch_handle, int i, const char* data, int len,
   auto* b = static_cast<Batch*>(batch_handle);
   if (i < 0 || i >= (int)b->items.size()) return;
   Pending* p = b->items[i];
-  std::lock_guard<std::mutex> lk(p->mu);
-  p->response.assign(data, len);
-  p->status = status;
-  p->done = true;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->response.assign(data, len);
+    p->status = status;
+    p->done = true;
+    p->cv.notify_one();  // under p->mu: p may be destroyed once we release
+  }
+  b->responded[i] = 1;  // same thread as the batcher loop — no lock needed
 }
 
 void pio_frontend_stop() {
@@ -435,9 +459,11 @@ void pio_frontend_stop() {
   fe->running = false;
   ::shutdown(fe->listen_fd, SHUT_RDWR);
   ::close(fe->listen_fd);
-  fe->qcv.notify_all();  // wake batcher → it drains + 503s leftovers
+  fe->qcv.notify_all();  // wake every batcher
   if (fe->acceptor.joinable()) fe->acceptor.join();
-  if (fe->batcher.joinable()) fe->batcher.join();
+  for (auto& t : fe->batchers)
+    if (t.joinable()) t.join();
+  drain_queue(fe);  // after ALL batchers are gone: 503 any leftovers
   // Close sockets no worker picked up, then release the pool.
   {
     std::lock_guard<std::mutex> lk(fe->cmu);

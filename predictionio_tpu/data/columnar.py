@@ -28,7 +28,7 @@ import pyarrow.compute as pc
 
 from predictionio_tpu.data.event import BiMap
 
-__all__ = ["encode_ids", "numeric_property", "event_mask"]
+__all__ = ["encode_ids", "numeric_property", "bool_property", "event_mask"]
 
 _ColumnLike = Union[pa.Array, pa.ChunkedArray]
 
@@ -63,12 +63,46 @@ def numeric_property(
     arr = _as_array(col)
     if len(arr) == 0:
         return np.empty(0, dtype=np.float64)
+    filled = pc.fill_null(arr, "")
     # json.dumps emits numbers bare: "key": -1.5e3, — capture to , } or ].
     pattern = '"' + re.escape(key) + '"\\s*:\\s*(?P<v>-?[0-9][0-9eE+\\-.]*)'
-    hit = pc.extract_regex(pc.fill_null(arr, ""), pattern=pattern)
+    hit = pc.extract_regex(filled, pattern=pattern)
     vals = pc.struct_field(hit, "v")
     nums = pc.cast(vals, pa.float64())
-    return pc.fill_null(nums, default).to_numpy(zero_copy_only=False)
+    out = pc.fill_null(nums, default).to_numpy(zero_copy_only=False).copy()
+    # Slow-path guard (round-2 advisor): the regex is only trustworthy when
+    # the key text appears EXACTLY once and matched a bare number.  A key
+    # repeated inside a nested object / string value, or a numeric value
+    # serialized as a string ("rating": "4.5"), falls back to a real JSON
+    # parse of just those rows — top-level key only, matching the flat
+    # DataMap property-bag semantics.
+    lit = '"' + key + '"'
+    cnt = pc.count_substring(filled, lit)
+    present = pc.greater(cnt, 0)
+    # The regex is trusted only when the key text occurs exactly once,
+    # matched a bare number, and sits BEFORE any nested object's opening
+    # brace — then it provably bound a top-level key.  A flat bag with a
+    # trailing nested value ({"rating": 4, "ctx": {...}}) stays on the
+    # vectorized path; only key-after-brace rows pay the JSON parse.
+    key_off = pc.find_substring(filled, lit)
+    brace2 = pc.find_substring(pc.utf8_slice_codeunits(filled, 1), "{")
+    nested_before_key = pc.and_(pc.greater_equal(brace2, 0),
+                                pc.greater(key_off, brace2))  # off-by-1 safe
+    ambiguous = pc.and_(present,
+                        pc.or_(pc.or_(pc.greater(cnt, 1), pc.is_null(nums)),
+                               nested_before_key))
+    amb_idx = np.flatnonzero(ambiguous.to_numpy(zero_copy_only=False))
+    if len(amb_idx):
+        import json as _json
+
+        raw = filled.take(pa.array(amb_idx)).to_pylist()
+        for i, s in zip(amb_idx, raw):
+            try:
+                v = _json.loads(s).get(key, default)
+                out[i] = float(v) if not isinstance(v, bool) else default
+            except (ValueError, TypeError, AttributeError):
+                out[i] = default
+    return out
 
 
 def bool_property(

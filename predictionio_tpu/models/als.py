@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,7 @@ from predictionio_tpu.ops.linalg import gram, masked_gram
 from predictionio_tpu.ops.pallas_kernels import (
     fits_vmem,
     fused_gram_vector_pallas,
+    gj_fits_vmem,
     pallas_supported,
     ridge_solve_gj_pallas,
 )
@@ -55,7 +56,9 @@ class ALSConfig:
     alpha: float = 1.0         # implicit confidence scale
     implicit: bool = False
     max_degree: Optional[int] = None   # truncate overlong entities (None = exact)
-    bucket_bounds: Sequence[int] = (16, 64, 256, 1024, 4096, 16384)
+    # "auto" fits bounds to the degree histogram (ops.ragged.fit_bounds,
+    # DP-minimal padded slots, sublane-aligned); a tuple pins them.
+    bucket_bounds: Union[Sequence[int], str] = "auto"
     # Zipf-head entities longer than this are split into partial rows and
     # their normal-equation pieces segment-summed — exact, and it removes
     # the dominant padding waste (measured 3.7x padded slots on the ML-1M
@@ -63,13 +66,13 @@ class ALSConfig:
     split_above: Optional[int] = 4096
     seed: int = 42
     dtype: str = "float32"     # factor storage dtype; solves always f32
-    # Matmul input precision for the gram/rhs builds (accumulation is
-    # always f32).  bfloat16 quadruples nominal MXU rate but measured no
-    # end-to-end win at ML-1M scale (the loop is not gram-bound) while
-    # costing recommendation quality on small/short-history entities, so
-    # f32 — matching MLlib — is the default; flip per-workload when the
-    # gram actually dominates (very high rank or degree).
-    gram_dtype: str = "float32"
+    # Gather + matmul input precision for the gram/rhs builds (factor
+    # MASTER copies and all accumulation stay f32; only the gathered
+    # operands are cast).  The v5e gather engine is row-rate limited
+    # (~0.34 G rows/s f32, ~0.46 bf16 measured) and the training loop is
+    # gather-bound at ML-25M, so "auto" = bfloat16 on TPU, float32
+    # elsewhere (CPU tests keep numpy-oracle exactness).
+    gram_dtype: str = "auto"
     # Normal-equation solver: "auto" = Pallas Gauss-Jordan on TPU (the XLA
     # batched Cholesky is the measured bottleneck of the whole training
     # loop), Cholesky elsewhere.  "cholesky"/"gj" force a path.
@@ -80,6 +83,13 @@ class ALSConfig:
     # several chunks are live at once inside the fused iteration loop, and
     # 1 GB blocks OOMed the 16 GB chip at ML-25M scale).
     max_block_floats: int = 1 << 26
+    # "auto" = bucket on-device (ops/device_prep.py) when running on TPU
+    # with no mesh and no max_degree truncation; True/False force.  The
+    # host-numpy bucketing + padded-block upload was 84% of end-to-end
+    # train wall time at ML-25M (round-2 verdict item 3); the device path
+    # ships compact COO once and runs the layout transform as one XLA
+    # program.
+    device_prep: Union[bool, str] = "auto"
 
 
 @dataclasses.dataclass
@@ -95,6 +105,16 @@ class ALSModel:
         return {"user_factors": self.user_factors, "item_factors": self.item_factors}
 
 
+def _resolve_gram_dtype(gram_dtype: str) -> str:
+    """"auto" → bfloat16 on TPU (gather row-rate win), float32 elsewhere."""
+    if gram_dtype == "auto":
+        try:
+            return "bfloat16" if jax.default_backend() == "tpu" else "float32"
+        except Exception:
+            return "float32"
+    return gram_dtype
+
+
 def _gram_pieces(
     indices: jax.Array,    # [R, L] int32 — other-side ids
     values: jax.Array,     # [R, L] f32
@@ -106,7 +126,6 @@ def _gram_pieces(
     gram_dtype,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Per-row normal-equation pieces: A [R,K,K], b [R,K], degree [R]."""
-    f = factors[indices]                      # [R, L, K] gather
     m = mask.astype(jnp.float32)
     if implicit:
         # Hu-Koren-Volinsky per MLlib: c = 1 + α·|r|, p = 1(r>0).
@@ -117,16 +136,21 @@ def _gram_pieces(
         w = m
         cvec = values * m
     if use_pallas:
+        f = factors[indices]                  # [R, L, K] gather, f32
         a, b = fused_gram_vector_pallas(f, w, cvec)
     else:
-        # Single-temp formulation: fold sqrt(w) into the gathered factors so
-        # only ONE [R, L, K] intermediate exists (the naive f and f*w pair
-        # doubled peak HBM and OOMed the ML-25M shape).  Entries with
-        # cvec != 0 but w == 0 (implicit feedback with alpha == 0) get an
-        # epsilon fold weight so the rhs survives the division exactly;
-        # the epsilon perturbs A by ~1e-12 per entry — far below the ridge.
+        # Gather in gram_dtype: the factor cast is [N, K] (cheap, one pass)
+        # and the row-rate-limited gather then moves half the bytes in
+        # bf16.  Single-temp formulation: fold sqrt(w) into the gathered
+        # factors so only ONE [R, L, K] intermediate exists (the naive f
+        # and f*w pair doubled peak HBM and OOMed the ML-25M shape).
+        # Entries with cvec != 0 but w == 0 (implicit feedback with
+        # alpha == 0) get an epsilon fold weight so the rhs survives the
+        # division exactly; the epsilon perturbs A by ~1e-12 per entry —
+        # far below the ridge.
+        f = factors.astype(gram_dtype)[indices]   # [R, L, K] gather
         sw = jnp.sqrt(w + jnp.where(cvec != 0.0, 1e-12, 0.0))
-        g = (f * sw[..., None]).astype(gram_dtype)
+        g = f * sw[..., None].astype(gram_dtype)
         a = jax.lax.dot_general(g, g, (((1,), (1,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32)
         s = (cvec / jnp.maximum(sw, 1e-30)).astype(gram_dtype)
@@ -188,7 +212,8 @@ def _side_step(
     yty = gram(src_factors) if implicit else jnp.zeros(
         (src_factors.shape[1], src_factors.shape[1]), jnp.float32)
     solved = _solve_bucket(indices, values, mask, src_factors, yty, reg, alpha,
-                           implicit, use_pallas, jnp.dtype(gram_dtype), solver)
+                           implicit, use_pallas,
+                           jnp.dtype(_resolve_gram_dtype(gram_dtype)), solver)
     return _scatter_rows(dst_factors, row_ids, solved)
 
 
@@ -228,7 +253,8 @@ def _merged_side_step(
         (src_factors.shape[1], src_factors.shape[1]), jnp.float32)
     return _merged_solve(indices, values, mask, seg_ids, ent_ids,
                          dst_factors, src_factors, yty, reg, alpha,
-                         implicit, use_pallas, jnp.dtype(gram_dtype), solver)
+                         implicit, use_pallas,
+                         jnp.dtype(_resolve_gram_dtype(gram_dtype)), solver)
 
 
 def _chunk_split_bucket(
@@ -354,7 +380,23 @@ def prepare_als_inputs(
     config: ALSConfig,
     mesh: Optional[Mesh] = None,
 ) -> ALSInputs:
-    """Host-side bucketing + H2D transfer for :func:`train_als_prepared`."""
+    """Bucketing + transfer for :func:`train_als_prepared`.
+
+    Dispatches to the device-side layout transform
+    (:mod:`predictionio_tpu.ops.device_prep`) on TPU — compact COO up,
+    one XLA program builds the padded blocks in HBM — and to the
+    host-numpy path elsewhere (CPU tests, meshes, max_degree truncation).
+    """
+    use_dev = config.device_prep
+    if use_dev == "auto":
+        try:
+            use_dev = (jax.default_backend() == "tpu" and mesh is None
+                       and config.max_degree is None)
+        except Exception:
+            use_dev = False
+    if use_dev:
+        return _prepare_als_inputs_device(user_ids, item_ids, ratings,
+                                          n_users, n_items, config)
     rng = np.random.default_rng(config.seed)
     k = config.rank
     pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
@@ -380,6 +422,122 @@ def prepare_als_inputs(
                          split_above=config.split_above),
         mesh, k, config.max_block_floats, pad_rows,
     )
+    return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
+                     item_buckets=item_buckets, n_users=n_users,
+                     n_items=n_items)
+
+
+def _chunk_device_bucket(arrs, rows_max: int):
+    """Row-chunk an oversized device bucket (HBM guard, device slices)."""
+    idx = arrs[0]
+    r = idx.shape[0]
+    if r <= rows_max:
+        return [arrs]
+    out = []
+    for s in range(0, r, rows_max):
+        e = min(s + rows_max, r)
+        chunk = tuple(a[s:e] for a in arrs)
+        if e - s < rows_max:  # pad the tail chunk to the shared shape
+            short = rows_max - (e - s)
+            idxc, valc, mskc, ridc = chunk
+            chunk = (jnp.pad(idxc, ((0, short), (0, 0))),
+                     jnp.pad(valc, ((0, short), (0, 0))),
+                     jnp.pad(mskc, ((0, short), (0, 0))),
+                     jnp.pad(ridc, (0, short), constant_values=-1))
+        out.append(chunk)
+    return out
+
+
+def _chunk_device_split(split, rows_max: int, pad_rows: int):
+    """Chunk a device split bucket at entity boundaries (cf. host
+    ``_chunk_split_bucket``): segment boundaries come off-device once
+    (tiny), slices stay on device."""
+    idx, vals, msk, seg_ids, ent_ids = split
+    r = idx.shape[0]
+    if r <= rows_max:
+        return [("merged", idx, vals, msk, seg_ids, ent_ids)]
+    seg_np = np.asarray(seg_ids)
+    n_seg = ent_ids.shape[0]
+    seg_starts = np.searchsorted(seg_np, np.arange(n_seg + 1), side="left")
+    out = []
+    e0 = 0
+    while e0 < n_seg:
+        e1 = e0 + 1
+        while e1 < n_seg and seg_starts[e1 + 1] - seg_starts[e0] <= rows_max:
+            e1 += 1
+        r0, r1 = int(seg_starts[e0]), int(seg_starts[e1])
+        if r1 == r0:
+            break
+        n_chunk = e1 - e0
+        row_pad = (-(r1 - r0)) % pad_rows
+        seg_pad = (-n_chunk) % pad_rows
+        seg = jnp.where((seg_ids[r0:r1] >= e0) & (seg_ids[r0:r1] < e1),
+                        seg_ids[r0:r1] - e0, n_chunk + seg_pad)
+        out.append((
+            "merged",
+            jnp.pad(idx[r0:r1], ((0, row_pad), (0, 0))),
+            jnp.pad(vals[r0:r1], ((0, row_pad), (0, 0))),
+            jnp.pad(msk[r0:r1], ((0, row_pad), (0, 0))),
+            jnp.pad(seg.astype(jnp.int32), (0, row_pad),
+                    constant_values=n_chunk + seg_pad),
+            jnp.pad(ent_ids[e0:e1], (0, seg_pad), constant_values=-1),
+        ))
+        e0 = e1
+    return out
+
+
+def _prepare_als_inputs_device(
+    user_ids, item_ids, ratings, n_users: int, n_items: int,
+    config: ALSConfig,
+) -> ALSInputs:
+    """Device-side prep: COO up once, layout transform on the chip."""
+    from predictionio_tpu.ops.device_prep import (
+        build_buckets, degree_histogram, plan_buckets,
+    )
+
+    k = config.rank
+    split_above = config.split_above or 1 << 20
+    rows_u = jnp.asarray(np.asarray(user_ids, dtype=np.int32)
+                         if isinstance(user_ids, np.ndarray) else user_ids,
+                         dtype=jnp.int32)
+    rows_i = jnp.asarray(np.asarray(item_ids, dtype=np.int32)
+                         if isinstance(item_ids, np.ndarray) else item_ids,
+                         dtype=jnp.int32)
+    if ratings is None:
+        vals = jnp.ones(rows_u.shape[0], jnp.float32)
+    else:
+        vals = jnp.asarray(ratings, dtype=jnp.float32)
+
+    key = jax.random.PRNGKey(config.seed)
+    ku, ki = jax.random.split(key)
+    uf = (jax.random.normal(ku, (n_users, k), jnp.float32)
+          / np.sqrt(k).astype(np.float32))
+    itf = (jax.random.normal(ki, (n_items, k), jnp.float32)
+           / np.sqrt(k).astype(np.float32))
+
+    def one_side(rows, cols, n_rows):
+        counts = jnp.zeros(n_rows, jnp.int32).at[rows].add(1)
+        hist, n_over, n_part = degree_histogram(counts, split_above)
+        plan = plan_buckets(hist, n_over, n_part, n_rows,
+                            split_above=split_above,
+                            bucket_bounds=config.bucket_bounds)
+        plain, split = build_buckets(rows, cols, vals, plan)
+        out = []
+        for arrs in plain:
+            l = arrs[0].shape[1]
+            rows_max = max(8, (config.max_block_floats // max(l * k, 1))
+                           // 8 * 8)
+            for chunk in _chunk_device_bucket(arrs, rows_max):
+                out.append(("plain", *chunk))
+        if split is not None:
+            l = split[0].shape[1]
+            rows_max = max(8, (config.max_block_floats // max(l * k, 1))
+                           // 8 * 8)
+            out.extend(_chunk_device_split(split, rows_max, 8))
+        return out
+
+    user_buckets = one_side(rows_u, rows_i, n_users)
+    item_buckets = one_side(rows_i, rows_u, n_items)
     return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
                      item_buckets=item_buckets, n_users=n_users,
                      n_items=n_items)
@@ -432,7 +590,9 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig) -> ALSModel:
     if solver == "auto":
         # The GJ kernel targets the MXU-adjacent VPU; on CPU meshes the
         # XLA Cholesky is fine and interpret-mode Pallas would be slow.
-        solver = "gj" if pallas_supported() else "cholesky"
+        # High ranks overflow the kernel's VMEM working set — Cholesky.
+        solver = "gj" if pallas_supported() and gj_fits_vmem(k) \
+            else "cholesky"
 
     # The WHOLE alternation loop is one jitted program: a fori_loop over
     # iterations with every bucket step unrolled in the body.  One dispatch
@@ -449,7 +609,8 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig) -> ALSModel:
     uf, itf = _train_loop(
         uf, itf, ubk, ibk, reg, alpha, jnp.int32(config.iterations),
         kinds=kinds, pallas_flags=pallas_flags,
-        implicit=config.implicit, gram_dtype=config.gram_dtype, solver=solver)
+        implicit=config.implicit,
+        gram_dtype=_resolve_gram_dtype(config.gram_dtype), solver=solver)
     return ALSModel(user_factors=uf, item_factors=itf, rank=k,
                     implicit=config.implicit)
 

@@ -1,7 +1,8 @@
 """Python binding for the C++ event-log feeder (native/feeder.cc).
 
 Write path: :func:`write_cache` converts indexed COO interactions (the
-output of a template DataSource) into the mmap-able PIOF1 columnar cache.
+output of a template DataSource) into the mmap-able PIOF1 columnar cache
+(version 2: optional extra f32 feature columns, e.g. DLRM dense features).
 Read path: :class:`EventFeeder` iterates shuffled batches assembled by the
 native library — numpy buffers are passed straight into C (no copies on
 the C side; the arrays handed back are the reusable buffers).
@@ -23,8 +24,13 @@ __all__ = ["write_cache", "EventFeeder"]
 _MAGIC = b"PIOF1"
 
 
-def write_cache(path, user_ids, item_ids, values=None, times=None) -> Path:
-    """Write the PIOF1 binary columnar event cache."""
+def write_cache(path, user_ids, item_ids, values=None, times=None,
+                extras=None) -> Path:
+    """Write the PIOF1 v2 binary columnar event cache.
+
+    ``extras``: optional ``[n, n_extra]`` float32 feature matrix, stored
+    column-major per the format (native/feeder.cc header comment).
+    """
     path = Path(path)
     user_ids = np.ascontiguousarray(user_ids, dtype=np.uint32)
     item_ids = np.ascontiguousarray(item_ids, dtype=np.uint32)
@@ -35,13 +41,24 @@ def write_cache(path, user_ids, item_ids, values=None, times=None) -> Path:
         times = np.zeros(n, dtype=np.int64)
     values = np.ascontiguousarray(values, dtype=np.float32)
     times = np.ascontiguousarray(times, dtype=np.int64)
+    if extras is not None:
+        extras = np.ascontiguousarray(extras, dtype=np.float32)
+        if extras.ndim == 1:
+            extras = extras[:, None]
+        assert extras.shape[0] == n, "extras rows must match event count"
+    n_extra = 0 if extras is None else extras.shape[1]
     with open(path, "wb") as f:
-        f.write(_MAGIC + b"\x00" + struct.pack("<H", 1))
+        f.write(_MAGIC + b"\x00" + struct.pack("<H", 2))
         f.write(struct.pack("<Q", n))
+        f.write(struct.pack("<II", n_extra, 0))
         f.write(user_ids.tobytes())
         f.write(item_ids.tobytes())
         f.write(values.tobytes())
+        pos = 24 + n * 12
+        f.write(b"\x00" * (-pos % 8))  # times are 8-byte aligned in v2
         f.write(times.tobytes())
+        for c in range(n_extra):
+            f.write(np.ascontiguousarray(extras[:, c]).tobytes())
     return path
 
 
@@ -58,42 +75,55 @@ class EventFeeder:
                                         ctypes.c_int]
         lib.pio_feeder_num_rows.restype = ctypes.c_int64
         lib.pio_feeder_num_rows.argtypes = [ctypes.c_void_p]
+        lib.pio_feeder_n_extra.restype = ctypes.c_int32
+        lib.pio_feeder_n_extra.argtypes = [ctypes.c_void_p]
         lib.pio_feeder_next_batch.restype = ctypes.c_int64
         lib.pio_feeder_next_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64)]
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float)]  # extras [batch, n_extra]
         lib.pio_feeder_close.argtypes = [ctypes.c_void_p]
         self._lib = lib
         self._h = lib.pio_feeder_open(str(path).encode(), seed, int(shuffle))
         if not self._h:
             raise RuntimeError(f"cannot open event cache {path!r}")
         self.batch_size = batch_size
+        self.n_extra = int(lib.pio_feeder_n_extra(self._h))
         self._users = np.empty(batch_size, np.uint32)
         self._items = np.empty(batch_size, np.uint32)
         self._vals = np.empty(batch_size, np.float32)
         self._times = np.empty(batch_size, np.int64)
+        self._extras = (np.empty((batch_size, self.n_extra), np.float32)
+                        if self.n_extra else None)
 
     def __len__(self) -> int:
         return int(self._lib.pio_feeder_num_rows(self._h))
 
-    def next_batch(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
-        """One batch of (users, items, values); None at an epoch boundary."""
+    def next_batch(self) -> Optional[Tuple[np.ndarray, ...]]:
+        """One batch of (users, items, values[, extras]); None at an epoch
+        boundary."""
         n = self._lib.pio_feeder_next_batch(
             self._h, self.batch_size,
             self._users.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             self._items.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._extras.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            if self._extras is not None
+            else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
         if n < 0:
             raise RuntimeError("feeder error")
         if n == 0:
             return None
         n = int(n)
-        return (self._users[:n].copy(), self._items[:n].copy(),
-                self._vals[:n].copy())
+        out = (self._users[:n].copy(), self._items[:n].copy(),
+               self._vals[:n].copy())
+        if self._extras is not None:
+            out = out + (self._extras[:n].copy(),)
+        return out
 
-    def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    def epoch(self) -> Iterator[Tuple[np.ndarray, ...]]:
         while True:
             b = self.next_batch()
             if b is None:

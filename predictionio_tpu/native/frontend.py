@@ -34,14 +34,15 @@ class NativeFrontend:
 
     def __init__(self, handler: Callable[[List[Any]], List[Any]],
                  host: str = "0.0.0.0", port: int = 8000,
-                 max_batch: int = 64, max_wait_us: int = 2000):
+                 max_batch: int = 64, max_wait_us: int = 2000,
+                 n_batchers: int = 4):
         lib = load_library("serving_frontend")
         if lib is None:
             raise RuntimeError("native frontend unavailable (g++ build failed)")
         lib.pio_frontend_start.restype = ctypes.c_int
         lib.pio_frontend_start.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            _BATCH_CB]
+            ctypes.c_int, _BATCH_CB]
         lib.pio_batch_request.restype = ctypes.c_char_p
         lib.pio_batch_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.POINTER(ctypes.c_int)]
@@ -55,6 +56,10 @@ class NativeFrontend:
         self.port: Optional[int] = None
         self.max_batch = max_batch
         self.max_wait_us = max_wait_us
+        # Batches in flight at once: each batcher thread independently
+        # forms a batch and drives the callback, so parse / predict /
+        # response writes overlap across batches.
+        self.n_batchers = n_batchers
         # Keep a reference — ctypes callbacks are GC'd otherwise.
         self._cb = _BATCH_CB(self._on_batch)
 
@@ -98,7 +103,7 @@ class NativeFrontend:
     def start(self) -> int:
         port = self._lib.pio_frontend_start(
             self._host.encode(), self._requested_port, self.max_batch,
-            self.max_wait_us, self._cb)
+            self.max_wait_us, self.n_batchers, self._cb)
         if port < 0:
             raise RuntimeError(f"pio_frontend_start failed ({port})")
         self.port = port

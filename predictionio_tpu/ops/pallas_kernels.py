@@ -27,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_gram_vector", "fused_gram_vector_pallas",
            "fused_gram_vector_xla", "pallas_supported",
-           "ridge_solve_gj_pallas"]
+           "ridge_solve_gj_pallas", "gj_fits_vmem"]
 
 
 def pallas_supported() -> bool:
@@ -128,6 +128,18 @@ def fused_gram_vector_pallas(f: jax.Array, w: jax.Array, c: jax.Array,
 # ---------------------------------------------------------------------------
 
 GJ_LANES = 128  # systems per program — one per vector lane
+
+
+def gj_fits_vmem(k: int) -> bool:
+    """Whether the GJ kernel's per-program working set fits VMEM.
+
+    The kernel holds the [k, k, 128] input block plus a same-shape VMEM
+    scratch (f32): 2·k²·128·4 bytes, with double-buffering on the input.
+    Budget ~12 MB of the ~16 MB/core keeps headroom; above it (k ≳ 96)
+    callers must take the Cholesky path — the kernel would fail to
+    compile where XLA's solver still works (round-2 advisor finding).
+    """
+    return 3 * k * k * GJ_LANES * 4 <= 12 * 1024 * 1024
 
 
 def _gj_kernel(a_ref, b_ref, x_ref, m_ref):

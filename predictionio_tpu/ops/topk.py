@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["top_k_scores", "chunked_top_k", "sharded_top_k"]
+__all__ = ["top_k_scores", "chunked_top_k", "sharded_top_k", "host_top_k"]
 
 NEG_INF = jnp.float32(-3.4e38)
 
@@ -128,3 +128,42 @@ def sharded_top_k(
         check_vma=False,
     )
     return fn(queries, items)
+
+
+def host_top_k(
+    queries,              # np [B, K]
+    items,                # np [N, K]
+    k: int,
+    *,
+    exclude=None,         # np [B, N] bool — True = mask out
+    biases=None,          # np [N]
+):
+    """Numpy top-k for the host-resident serving fast path.
+
+    A B=1 predict over even ML-25M-scale item factors is ~4M MACs — far
+    below the cost of one device dispatch round-trip (milliseconds on a
+    production host, ~100 ms through this harness's remote-TPU tunnel).
+    Serving keeps a host copy of the factors and answers small batches
+    here; large batches still go to the device (ops.topk.top_k_scores).
+    Returns ([B, k], [B, k] int32) sorted descending like lax.top_k.
+    """
+    import numpy as np
+
+    if k <= 0:  # lax.top_k parity: k=0 → empty, never the whole catalog
+        return (np.empty((queries.shape[0], 0), np.float32),
+                np.empty((queries.shape[0], 0), np.int32))
+    scores = queries @ items.T                      # [B, N]
+    if biases is not None:
+        scores = scores + biases[None, :]
+    if exclude is not None:
+        scores = np.where(exclude, -3.4e38, scores)
+    n = scores.shape[1]
+    k = min(k, n)
+    if k < n:
+        part = np.argpartition(scores, -k, axis=1)[:, -k:]
+    else:
+        part = np.broadcast_to(np.arange(n), scores.shape).copy()
+    part_scores = np.take_along_axis(scores, part, axis=1)
+    order = np.argsort(-part_scores, axis=1, kind="stable")
+    ids = np.take_along_axis(part, order, axis=1).astype(np.int32)
+    return np.take_along_axis(part_scores, order, axis=1), ids

@@ -213,9 +213,11 @@ class EventServer:
             q["limit"] = None if limit == -1 else limit
             q["reversed"] = params.get("reversed", ["false"])[0].lower() == "true"
             found = list(events.find(key_row.app_id, channel_id, **q))
-            # An empty match is a valid result, not an error: 200 [].
-            # (Round-1 returned 404 here; VERDICT.md flagged it as a
-            # divergence — only the single-event GET /events/<id> 404s.)
+            # Deliberate divergence from upstream (documented in
+            # PARITY.md): upstream's event server answers an empty list
+            # query with 404 {"message":"Not Found"}; here an empty match
+            # is a valid result — 200 [].  Only the single-event
+            # GET /events/<id> 404s.
             return 200, [event_to_json(e) for e in found]
 
         if path.startswith("/webhooks/") and method == "POST":

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,6 +37,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.ops.topk import host_top_k
 
 __all__ = [
     "engine",
@@ -123,9 +125,24 @@ class RecommendationDataSource(DataSource):
         user_ids, user_index = encode_ids(table.column("entity_id"))
         item_ids, item_index = encode_ids(table.column("target_entity_id"))
         is_rate = event_mask(table, ["rate"])
-        ratings = np.where(is_rate,
-                           numeric_property(table, "rating", default=0.0),
-                           p.buyRating).astype(np.float32)
+        raw = numeric_property(table, "rating", default=np.nan)
+        ratings = np.where(is_rate, raw, p.buyRating).astype(np.float32)
+        # Decided semantic (round-2 verdict item 8, PARITY.md): a `rate`
+        # event with no numeric `rating` property is DROPPED with a
+        # warning — never trained as rating 0.0 (a strong negative signal
+        # in explicit ALS).  Upstream's DataSource would throw and fail
+        # the whole train; dropping keeps one malformed producer from
+        # taking down retraining.
+        bad = is_rate & ~np.isfinite(ratings)
+        if bad.any():
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "dropping %d rate event(s) without a numeric 'rating' "
+                "property", int(bad.sum()))
+            keep = ~bad
+            user_ids, item_ids = user_ids[keep], item_ids[keep]
+            ratings = ratings[keep]
         return Ratings(
             user_ids=user_ids,
             item_ids=item_ids,
@@ -200,6 +217,17 @@ class ALSModelWrapper:
     model: als_lib.ALSModel
     user_index: BiMap
     item_index: BiMap
+    # Host-resident factor copies for the serving fast path: a B=1
+    # predict is ~N·K MACs — orders of magnitude below one device
+    # dispatch round-trip — so small batches are answered in numpy from
+    # these (pulled once, lazily).  None until first host predict.
+    _host: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def host_factors(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._host is None:
+            self._host = jax.device_get(
+                (self.model.user_factors, self.model.item_factors))
+        return self._host
 
 
 class ALSAlgorithm(Algorithm):
@@ -240,10 +268,11 @@ class ALSAlgorithm(Algorithm):
         uidx = model.user_index.get(query.user)
         if uidx is None:
             return PredictedResult(itemScores=[])  # unknown user (reference parity)
-        scores, ids = als_lib.recommend(
-            model.model, jnp.asarray([uidx]), min(query.num, len(model.item_index))
-        )
-        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
+        # Host fast path: one matmul row + argpartition beats a device
+        # dispatch round-trip for any single query (see ops.topk.host_top_k).
+        uf, itf = model.host_factors()
+        scores, ids = host_top_k(uf[uidx:uidx + 1], itf,
+                                 min(query.num, len(model.item_index)))
         inv = model.item_index.inverse
         return PredictedResult(
             itemScores=[
@@ -266,17 +295,24 @@ class ALSAlgorithm(Algorithm):
                if q.user not in model.user_index]
         if known:
             num = max(q.num for _, q in known)
-            b = 1 << (len(known) - 1).bit_length()  # next pow2
             idxs = [model.user_index[q.user] for _, q in known]
-            uidx = jnp.asarray(idxs + [0] * (b - len(idxs)))
             k_menu = (1, 10, 100, 1000)
             k = min(len(model.item_index),
                     next((m for m in k_menu if m >= num), num))
-            scores, ids = als_lib.recommend(model.model, uidx, k)
-            # ONE host transfer for the whole batch — per-row np.asarray
-            # would round-trip the device per request (p50 death by 1000
-            # transfers on a tunneled TPU).
-            scores, ids = jax.device_get((scores, ids))
+            # Host when the batch matmul is small (one device dispatch
+            # round-trip costs more than ~1e8 host MACs); device for big
+            # sweeps (batch eval over the full catalog).
+            work = len(idxs) * len(model.item_index) * model.model.rank
+            if work <= int(os.environ.get("PIO_SERVE_HOST_MACS", 2 * 10**8)):
+                uf, itf = model.host_factors()
+                scores, ids = host_top_k(uf[np.asarray(idxs)], itf, k)
+            else:
+                b = 1 << (len(known) - 1).bit_length()  # next pow2
+                uidx = jnp.asarray(idxs + [0] * (b - len(idxs)))
+                scores, ids = als_lib.recommend(model.model, uidx, k)
+                # ONE host transfer for the whole batch — per-row
+                # np.asarray would round-trip the device per request.
+                scores, ids = jax.device_get((scores, ids))
             inv = model.item_index.inverse
             for row, (i, q) in enumerate(known):
                 out.append((i, PredictedResult(itemScores=[

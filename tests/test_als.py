@@ -205,3 +205,58 @@ def test_split_chunking_matches_unsplit():
     np.testing.assert_allclose(np.asarray(m_plain.item_factors),
                                np.asarray(m_chunk.item_factors),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_gram_quality():
+    """bf16 gathered operands (the TPU default) must not hurt fit quality.
+
+    PARITY.md pins this: master factors and accumulation stay f32; only
+    the gathered gram/rhs operands are bf16.  RMSE after full training on
+    a recoverable low-rank problem must match the f32 path closely.
+    """
+    rng = np.random.default_rng(7)
+    n_u, n_i, n = 80, 60, 3000
+    tu = rng.standard_normal((n_u, 4))
+    ti = rng.standard_normal((n_i, 4))
+    users = rng.integers(0, n_u, n)
+    items = rng.integers(0, n_i, n)
+    ratings = np.sum(tu[users] * ti[items], axis=1).astype(np.float32)
+    f32 = ALSConfig(rank=8, iterations=8, reg=0.05, seed=1,
+                    gram_dtype="float32")
+    bf16 = ALSConfig(rank=8, iterations=8, reg=0.05, seed=1,
+                     gram_dtype="bfloat16")
+    m32 = train_als(users, items, ratings, n_u, n_i, f32)
+    m16 = train_als(users, items, ratings, n_u, n_i, bf16)
+    r32 = rmse(m32, users, items, ratings)
+    r16 = rmse(m16, users, items, ratings)
+    scale = float(np.sqrt(np.mean(ratings ** 2)))
+    assert abs(r16 - r32) < 0.02 * scale, (r32, r16)
+
+
+def test_fit_bounds_reduces_padding():
+    """DP-fitted bounds must never pad more than the fixed defaults and
+    must stay sublane-aligned."""
+    from predictionio_tpu.ops.ragged import fit_bounds
+
+    rng = np.random.default_rng(0)
+    counts = np.concatenate([
+        rng.integers(100, 220, 5000),       # user-like bulk
+        (rng.zipf(1.3, 500) % 4000) + 1,    # zipf tail
+    ])
+    bounds = fit_bounds(counts, cap=4096)
+    assert all(b % 8 == 0 for b in bounds)
+    assert bounds == sorted(set(bounds))
+
+    def padded(bs):
+        c = np.minimum(counts, 4096)
+        tot, prev = 0, 0
+        for b in sorted(bs):
+            sel = (c > prev) & (c <= b)
+            tot += sel.sum() * b
+            prev = b
+        assert prev >= c.max()
+        return tot
+
+    fixed = [16, 64, 256, 1024, 4096]
+    assert padded(bounds) <= padded(fixed)
+    assert padded(bounds) <= 1.15 * counts.clip(max=4096).sum()

@@ -118,3 +118,50 @@ def test_scale_smoke():
     assert len(uindex) <= 160_000
     # Generous bound: the round-1 loop took minutes at this size.
     assert dt < 20.0, f"columnar transforms too slow: {dt:.1f}s"
+
+
+class TestNumericPropertyEdgeCases:
+    """Round-2 advisor: nested keys / string-numbers must not mis-extract."""
+
+    def _col(self, rows):
+        import pyarrow as pa
+        return pa.array(rows, type=pa.string())
+
+    def test_nested_same_name_key_is_not_matched(self):
+        from predictionio_tpu.data.columnar import numeric_property
+        col = self._col([
+            '{"meta": {"rating": 1}, "rating": 5}',
+            '{"rating": 3}',
+            '{"meta": {"rating": 9}}',  # no TOP-LEVEL rating → default
+        ])
+        out = numeric_property(col, "rating", default=-1.0)
+        assert out.tolist() == [5.0, 3.0, -1.0]
+
+    def test_string_encoded_number_coerces(self):
+        from predictionio_tpu.data.columnar import numeric_property
+        col = self._col(['{"rating": "4.5"}', '{"rating": 2}'])
+        out = numeric_property(col, "rating", default=0.0)
+        assert out.tolist() == [4.5, 2.0]
+
+    def test_key_text_inside_string_value(self):
+        from predictionio_tpu.data.columnar import numeric_property
+        col = self._col([
+            '{"note": "my \\"rating\\": 3 memo", "rating": 4}',
+            '{"note": "contains \\"rating\\": 7 only"}',
+        ])
+        out = numeric_property(col, "rating", default=0.0)
+        assert out.tolist() == [4.0, 0.0]
+
+    def test_non_numeric_and_bool_values_default(self):
+        from predictionio_tpu.data.columnar import numeric_property
+        col = self._col(['{"rating": true, "x": {"rating": 2}}',
+                         '{"rating": null, "y": {"rating": 1}}'])
+        out = numeric_property(col, "rating", default=-2.0)
+        assert out.tolist() == [-2.0, -2.0]
+
+    def test_flat_key_before_nested_value_stays_correct(self):
+        from predictionio_tpu.data.columnar import numeric_property
+        col = self._col(['{"rating": 4, "ctx": {"rating": 9, "z": 1}}',
+                         '{"ctx": {"rating": 9}, "rating": 2}'])
+        out = numeric_property(col, "rating", default=0.0)
+        assert out.tolist() == [4.0, 2.0]

@@ -1,0 +1,128 @@
+"""Device-side bucketing (ops/device_prep.py) vs the host-numpy oracle.
+
+The device path must produce byte-identical bucket CONTENTS (same entries
+per entity, same within-row event order, same split-segment layout) as
+``bucket_by_length``; only row/slot ordering metadata may differ, and the
+ALS consumer is invariant to that by construction (row_ids route scatter).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from predictionio_tpu.models.als import ALSConfig, train_als, rmse
+from predictionio_tpu.ops.device_prep import (
+    build_buckets, degree_histogram, plan_buckets,
+)
+from predictionio_tpu.ops.ragged import bucket_by_length
+
+
+def _coo(seed=3, n_rows=400, n_cols=300, n=20_000, zipf=1.3):
+    rng = np.random.default_rng(seed)
+    rows = (rng.zipf(zipf, n) % n_rows).astype(np.int32)
+    cols = rng.integers(0, n_cols, n).astype(np.int32)
+    vals = rng.random(n).astype(np.float32)
+    return rows, cols, vals
+
+
+def _device_side(rows, cols, vals, n_rows, split_above):
+    counts = jnp.zeros(n_rows, jnp.int32).at[jnp.asarray(rows)].add(1)
+    hist, n_over, n_part = degree_histogram(counts, split_above)
+    plan = plan_buckets(hist, n_over, n_part, n_rows,
+                        split_above=split_above, pad_rows_to=8)
+    return build_buckets(jnp.asarray(rows), jnp.asarray(cols),
+                         jnp.asarray(vals), plan)
+
+
+class TestDeviceBucketEquivalence:
+    @pytest.mark.parametrize("split_above", [64, 8192])
+    def test_matches_host_oracle(self, split_above):
+        rows, cols, vals = _coo()
+        n_rows = 400
+        host = bucket_by_length(rows.astype(np.int64), cols.astype(np.int64),
+                                vals, n_rows, split_above=split_above,
+                                pad_rows_to=8)
+        plain, split = _device_side(rows, cols, vals, n_rows, split_above)
+        host_plain = [p for p in host if not p.split]
+        assert len(host_plain) == len(plain)
+        for hp, dp in zip(host_plain, plain):
+            idx, val, msk, rid = [np.asarray(x) for x in dp]
+            hmap = {int(r): i for i, r in enumerate(hp.row_ids) if r >= 0}
+            dmap = {int(r): i for i, r in enumerate(rid) if r >= 0}
+            assert set(hmap) == set(dmap)
+            for r in hmap:
+                hi, di = hmap[r], dmap[r]
+                assert np.array_equal(hp.indices[hi][hp.mask[hi]],
+                                      idx[di][msk[di]])
+                assert np.array_equal(hp.values[hi][hp.mask[hi]],
+                                      val[di][msk[di]])
+        host_split = [p for p in host if p.split]
+        if not host_split:
+            assert split is None
+            return
+        hs = host_split[0]
+        didx, dval, dmsk, dseg, dent = [np.asarray(x) for x in split]
+        for e_h, ent_id in enumerate(hs.ent_ids):
+            if ent_id < 0:
+                continue
+            h_rows = np.where(hs.seg_ids == e_h)[0]
+            h_seq = np.concatenate(
+                [hs.indices[r][hs.mask[r]] for r in h_rows])
+            (e_d,) = np.where(dent == ent_id)
+            d_rows = np.where(dseg == e_d[0])[0]
+            d_seq = np.concatenate([didx[r][dmsk[r]] for r in d_rows])
+            assert np.array_equal(h_seq, d_seq)
+
+    def test_nnz_conserved(self):
+        rows, cols, vals = _coo(seed=7)
+        plain, split = _device_side(rows, cols, vals, 400, 64)
+        tot = sum(int(np.asarray(p[2]).sum()) for p in plain)
+        if split is not None:
+            tot += int(np.asarray(split[2]).sum())
+        assert tot == len(rows)
+
+    def test_no_split_when_all_short(self):
+        rows = np.arange(100, dtype=np.int32)
+        cols = np.arange(100, dtype=np.int32)
+        vals = np.ones(100, np.float32)
+        plain, split = _device_side(rows, cols, vals, 100, 4096)
+        assert split is None
+        assert sum(int(np.asarray(p[2]).sum()) for p in plain) == 100
+
+
+class TestTrainWithDevicePrep:
+    def test_train_converges_like_host_path(self):
+        """Same data through both prep paths → same fit quality.
+
+        Inits differ (host numpy rng vs device PRNG) so factors are not
+        bitwise comparable; RMSE after a few sweeps must match closely.
+        """
+        rng = np.random.default_rng(0)
+        n_u, n_i, n = 120, 80, 4000
+        true_u = rng.standard_normal((n_u, 4))
+        true_i = rng.standard_normal((n_i, 4))
+        users = rng.integers(0, n_u, n)
+        items = (rng.zipf(1.4, n) % n_i).astype(np.int64)
+        ratings = np.sum(true_u[users] * true_i[items], axis=1).astype(
+            np.float32)
+        cfg_host = ALSConfig(rank=8, iterations=6, reg=0.05, seed=1,
+                             device_prep=False, split_above=64)
+        cfg_dev = ALSConfig(rank=8, iterations=6, reg=0.05, seed=1,
+                            device_prep=True, split_above=64)
+        m_host = train_als(users, items, ratings, n_u, n_i, cfg_host)
+        m_dev = train_als(users, items, ratings, n_u, n_i, cfg_dev)
+        r_host = rmse(m_host, users, items, ratings)
+        r_dev = rmse(m_dev, users, items, ratings)
+        assert abs(r_host - r_dev) < 0.05 * max(r_host, 0.1)
+
+    def test_chunking_path(self):
+        """A tiny max_block_floats forces bucket chunking on device."""
+        rows, cols, vals = _coo(seed=5, n_rows=64, n_cols=64, n=6000,
+                                zipf=1.2)
+        cfg = ALSConfig(rank=8, iterations=2, reg=0.05, seed=1,
+                        device_prep=True, split_above=32,
+                        max_block_floats=1 << 14)
+        m = train_als(rows, cols, vals, 64, 64, cfg)
+        assert np.isfinite(np.asarray(m.user_factors)).all()
+        assert np.isfinite(np.asarray(m.item_factors)).all()

@@ -40,6 +40,28 @@ class TestFeeder:
             np.testing.assert_array_equal(np.concatenate(got_i)[order], items)
             np.testing.assert_allclose(np.concatenate(got_v)[order], vals)
 
+    def test_v2_extras_roundtrip(self, tmp_path):
+        """n_extra > 0: the 7-arg next_batch ABI carries extra columns
+        (round-2 advisor: the 6-arg binding read a garbage pointer)."""
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        rng = np.random.default_rng(0)
+        n = 77  # odd count exercises the v2 8-byte alignment pad
+        users = np.arange(n, dtype=np.uint32)
+        items = (users * 3) % 13
+        vals = rng.random(n).astype(np.float32)
+        extras = rng.random((n, 3)).astype(np.float32)
+        path = write_cache(tmp_path / "v2.piof", users, items, vals,
+                           extras=extras)
+        with EventFeeder(path, batch_size=19, seed=2) as f:
+            assert f.n_extra == 3
+            got = [b for b in f.epoch()]
+            all_u = np.concatenate([b[0] for b in got])
+            all_e = np.concatenate([b[3] for b in got])
+            order = np.argsort(all_u)
+            np.testing.assert_array_equal(all_u[order], users)
+            np.testing.assert_allclose(all_e[order], extras)
+
     def test_epochs_differ_deterministically(self, tmp_path):
         from predictionio_tpu.native.feeder import EventFeeder, write_cache
 

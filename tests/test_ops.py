@@ -24,7 +24,8 @@ class TestPadRagged:
         cols = np.array([5, 7, 1, 2, 3, 9])
         vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], dtype=np.float32)
         p = pad_ragged(rows, cols, vals, n_rows=3)
-        assert p.shape == (3, 3)
+        # Width = max row len (3) rounded up to the sublane granule (8).
+        assert p.shape == (3, 8)
         assert p.mask.sum() == 6
         # Row 0: two entries in insertion order.
         assert list(p.indices[0][p.mask[0]]) == [5, 7]
@@ -34,7 +35,8 @@ class TestPadRagged:
         rows = np.zeros(5, dtype=np.int64)
         cols = np.arange(5)
         p = pad_ragged(rows, cols, None, n_rows=1, max_len=3)
-        assert list(p.indices[0]) == [2, 3, 4]
+        assert list(p.indices[0][p.mask[0]]) == [2, 3, 4]
+        assert not p.mask[0, 3:].any()  # aligned tail is masked padding
 
     def test_empty_rows_and_row_padding(self):
         p = pad_ragged(np.array([1]), np.array([0]), None, n_rows=3, pad_rows_to=4)

@@ -113,3 +113,50 @@ def test_batch_predict_matches_single(ctx):
     single0 = algo.predict(models[0], Query(user="u0", num=3))
     assert [s.item for s in batch[0].itemScores] == [s.item for s in single0.itemScores]
     assert batch[2].itemScores == []
+
+
+def test_rate_without_rating_dropped(pio_home):
+    """Decided semantic (PARITY.md): malformed rate events are dropped,
+    not trained as rating 0.0 — and training proceeds."""
+    import numpy as np
+    from predictionio_tpu.controller import RuntimeContext
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.templates.recommendation.engine import (
+        DataSourceParams, RecommendationDataSource,
+    )
+
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="dropapp"))
+    storage.get_events().init(app_id)
+    ev = storage.get_events()
+    good = [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": float(1 + i % 5)}))
+            for i in range(20)]
+    bad = [Event(event="rate", entity_type="user", entity_id="u0",
+                 target_entity_type="item", target_entity_id="i1",
+                 properties=DataMap({})),        # no rating at all
+           Event(event="rate", entity_type="user", entity_id="u1",
+                 target_entity_type="item", target_entity_id="i2",
+                 properties=DataMap({"rating": "not-a-number"}))]
+    ev.insert_batch(good + bad, app_id)
+    ds = RecommendationDataSource(DataSourceParams(appName="dropapp"))
+    ctx = RuntimeContext.create(storage=storage)
+    data = ds.read_training(ctx)
+    assert len(data.ratings) == 20          # the two malformed rows gone
+    assert np.isfinite(data.ratings).all()
+    assert (data.ratings > 0).all()
+
+
+def test_query_num_zero_returns_empty(trained_rec_engine=None):
+    """num=0 must yield an empty result, not the whole catalog."""
+    import numpy as np
+    from predictionio_tpu.ops.topk import host_top_k
+
+    q = np.ones((1, 4), np.float32)
+    items = np.ones((10, 4), np.float32)
+    s, i = host_top_k(q, items, 0)
+    assert s.shape == (1, 0) and i.shape == (1, 0)
+    s, i = host_top_k(q, items, -3)
+    assert s.shape == (1, 0)

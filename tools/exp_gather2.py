@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Gather mechanism shootout (scratch)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R1, R2 = 4, 20
+
+
+def slope(fn, *args):
+    def run(n):
+        t0 = time.perf_counter()
+        out = fn(jnp.int32(n), jnp.float32(0.0), *args)
+        float(jnp.sum(out))
+        return time.perf_counter() - t0
+    run(R1)
+    t1 = run(R1); t2 = run(R2)
+    return (t2 - t1) / (R2 - R1) * 1e3
+
+
+I, K = 59_047, 64
+R, L = 20_000, 256
+NNZ = R * L
+rng = np.random.default_rng(0)
+Y32 = jnp.asarray(rng.standard_normal((I, K), dtype=np.float32))
+Y16 = Y32.astype(jnp.bfloat16)
+idx = jnp.asarray((rng.zipf(1.25, size=(R, L)) % I).astype(np.int32))
+idx_head = jnp.minimum(idx, 2047)
+
+
+@jax.jit
+def rep_gather(n, zero, Y, ix):
+    def body(_, c):
+        f = (Y + c.astype(Y.dtype) * zero.astype(Y.dtype))[ix]
+        return jnp.sum(f.astype(jnp.float32)) * 1e-20
+    return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+
+TILE_R = 8
+
+
+def make_kernel(mode):
+    def _gk(idx_ref, y_ref, o_ref, scratch):
+        l = idx_ref.shape[1]
+        for r in range(TILE_R):
+            if mode == "take":
+                scratch[:] = jnp.take(y_ref[:], idx_ref[r], axis=0,
+                                      fill_value=0)
+            elif mode == "loop8":
+                def body(j, _):
+                    for u in range(8):
+                        scratch[j * 8 + u] = y_ref[idx_ref[r, j * 8 + u]]
+                    return 0
+                jax.lax.fori_loop(0, l // 8, body, 0)
+            else:
+                def body(j, _):
+                    scratch[j] = y_ref[idx_ref[r, j]]
+                    return 0
+                jax.lax.fori_loop(0, l, body, 0)
+            o_ref[r] = jnp.sum(scratch[:], axis=0)
+    return _gk
+
+
+def pallas_gather(mode, smem_idx=True):
+    @jax.jit
+    def f(ix, y):
+        r, l = ix.shape
+        return pl.pallas_call(
+            make_kernel(mode),
+            grid=(r // TILE_R,),
+            in_specs=[
+                pl.BlockSpec((TILE_R, l), lambda i: (i, 0),
+                             memory_space=pltpu.SMEM if smem_idx else None),
+                pl.BlockSpec((y.shape[0], y.shape[1]), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((TILE_R, K), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((r, K), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((l, K), jnp.float32)],
+        )(ix, y)
+    return f
+
+
+def rep_pallas(mode, smem_idx=True):
+    g = pallas_gather(mode, smem_idx)
+    @jax.jit
+    def f(n, zero, ix, y):
+        def body(_, c):
+            o = g(ix, y + c * zero)
+            return jnp.sum(o) * 1e-20
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+    return f
+
+
+def main():
+    which = sys.argv[1:] or ["xla", "take", "loop8"]
+    if "xla" in which:
+        ms = slope(rep_gather, Y32, idx)
+        print(f"xla f32 zipf : {ms:8.2f} ms  {NNZ*K*4/ms/1e6:7.1f} GB/s "
+              f"{NNZ/ms/1e6:6.2f} Gnnz/s")
+        ms = slope(rep_gather, Y16, idx)
+        print(f"xla bf16 zipf: {ms:8.2f} ms  {NNZ*K*2/ms/1e6:7.1f} GB/s "
+              f"{NNZ/ms/1e6:6.2f} Gnnz/s")
+        ms = slope(rep_gather, Y32, idx_head)
+        print(f"xla f32 head : {ms:8.2f} ms  {NNZ*K*4/ms/1e6:7.1f} GB/s "
+              f"{NNZ/ms/1e6:6.2f} Gnnz/s")
+    if "take" in which:
+        try:
+            ms = slope(rep_pallas("take"), idx, Y32)
+            print(f"pl take      : {ms:8.2f} ms  {NNZ/ms/1e6:6.2f} Gnnz/s")
+        except Exception as e:
+            print(f"pl take      : FAIL {type(e).__name__}: {str(e)[:200]}")
+    if "loop8" in which:
+        try:
+            ms = slope(rep_pallas("loop8"), idx, Y32)
+            print(f"pl loop8     : {ms:8.2f} ms  {NNZ/ms/1e6:6.2f} Gnnz/s")
+        except Exception as e:
+            print(f"pl loop8     : FAIL {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

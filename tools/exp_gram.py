@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Micro-experiments for the ALS gather+gram redesign (scratch)."""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R1, R2 = 4, 20
+
+
+def slope(fn, *args):
+    def run(n):
+        t0 = time.perf_counter()
+        out = fn(jnp.int32(n), jnp.float32(0.0), *args)
+        float(jnp.sum(out))
+        return time.perf_counter() - t0
+    run(R1)
+    t1 = run(R1); t2 = run(R2)
+    return (t2 - t1) / (R2 - R1) * 1e3
+
+
+I, K = 59_047, 64
+R, L = 20_000, 256          # one representative user bucket: 5.1M nnz slots
+rng = np.random.default_rng(0)
+Y = jnp.asarray(rng.standard_normal((I, K), dtype=np.float32))
+idx = jnp.asarray((rng.zipf(1.25, size=(R, L)) % I).astype(np.int32))
+idx_sorted = jnp.sort(idx, axis=1)
+w = jnp.asarray(rng.random((R, L), dtype=np.float32))
+G = Y[idx] * w[..., None]
+NNZ = R * L
+GB = NNZ * K * 4 / 1e9
+GF = 2 * NNZ * K * K / 1e9
+
+
+@jax.jit
+def rep_gather(n, zero, Y, idx):
+    def body(_, c):
+        f = (Y + c * zero)[idx]
+        return jnp.sum(f) * 1e-20
+    return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+
+def rep_gram_mat(dtype):
+    @jax.jit
+    def f(n, zero, G):
+        def body(_, c):
+            g = (G + c * zero).astype(dtype)
+            a = jax.lax.dot_general(g, g, (((1,), (1,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            return jnp.sum(a) * 1e-20
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+    return f
+
+
+def rep_fused(dtype):
+    @jax.jit
+    def f(n, zero, Y, idx, w):
+        def body(_, c):
+            g = ((Y + c * zero)[idx] * w[..., None]).astype(dtype)
+            a = jax.lax.dot_general(g, g, (((1,), (1,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            return jnp.sum(a) * 1e-20
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+    return f
+
+
+# --- Pallas: Y resident in VMEM, per-row scalar-loop gather + MXU gram ---
+TILE_R = 8
+
+
+def _gk(idx_ref, w_ref, y_ref, a_ref, scratch):
+    # idx/w: [TILE_R, L] (idx in SMEM), y: [I, K] VMEM-resident, a: [TILE_R,K,K]
+    l = idx_ref.shape[1]
+    for r in range(TILE_R):
+        def body(j, _):
+            scratch[j] = y_ref[idx_ref[r, j]]
+            return 0
+        jax.lax.fori_loop(0, l, body, 0)
+        g = scratch[:] * w_ref[r][:, None]
+        a_ref[r] = jax.lax.dot_general(
+            g, scratch[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def pallas_vmem_gather_gram(idx, w, y):
+    r, l = idx.shape
+    return pl.pallas_call(
+        _gk,
+        grid=(r // TILE_R,),
+        in_specs=[
+            pl.BlockSpec((TILE_R, l), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((TILE_R, l), lambda i: (i, 0)),
+            pl.BlockSpec((y.shape[0], y.shape[1]), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_R, K, K), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, K, K), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((l, K), jnp.float32)],
+    )(idx, w, y)
+
+
+@jax.jit
+def rep_pallas_vmem(n, zero, idx, w, y):
+    def body(_, c):
+        a = pallas_vmem_gather_gram(idx, w, y + c * zero)
+        return jnp.sum(a) * 1e-20
+    return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+
+def main():
+    which = sys.argv[1:] or ["gather", "gram", "fused", "pallas"]
+    if "gather" in which:
+        ms = slope(rep_gather, Y, idx)
+        print(f"gather zipf      : {ms:8.2f} ms  {GB/ms*1e3:7.1f} GB/s")
+        ms = slope(rep_gather, Y, idx_sorted)
+        print(f"gather sorted    : {ms:8.2f} ms  {GB/ms*1e3:7.1f} GB/s")
+    if "gram" in which:
+        ms = slope(rep_gram_mat(jnp.float32), G)
+        print(f"gram mat f32     : {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s")
+        ms = slope(rep_gram_mat(jnp.bfloat16), G)
+        print(f"gram mat bf16    : {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s")
+    if "fused" in which:
+        ms = slope(rep_fused(jnp.float32), Y, idx, w)
+        print(f"gather+gram f32  : {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s")
+        ms = slope(rep_fused(jnp.bfloat16), Y, idx, w)
+        print(f"gather+gram bf16 : {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s")
+        ms = slope(rep_fused(jnp.float32), Y, idx_sorted, w)
+        print(f"gather+gram srt32: {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s")
+    if "pallas" in which:
+        ms = slope(rep_pallas_vmem, idx, w, Y)
+        print(f"pallas vmem-gthr : {ms:8.2f} ms  {GF/ms*1e3/1e3:7.2f} TF/s "
+              f"({NNZ/ms*1e3/1e9:5.2f} Gnnz/s)")
+
+
+if __name__ == "__main__":
+    main()

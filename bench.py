@@ -158,6 +158,7 @@ def train_bench():
     t1, _ = run(I1)
     t2, m = run(I2)
     per_iter = max((t2 - t1) / (I2 - I1), 1e-9)
+    phases = phase_profile(inputs)
 
     n_chips = max(1, len(jax.devices()))
     samples_per_sec_chip = N_RATINGS / per_iter / n_chips
@@ -171,9 +172,63 @@ def train_bench():
         "h2d_coo_s": round(h2d_s, 2),       # tunnel artifact, see comment
         "e2e_full_train_s": round(h2d_s + prep_s + t2, 2),
         "n_chips": n_chips,
+        "phase_ms": phases,   # per-iteration device-time breakdown
         "shape": f"{N_USERS}x{N_ITEMS}x{N_RATINGS} rank{RANK}",
         "mesh": os.environ.get("PIO_MESH") or None,
     }
+
+
+def phase_profile(inputs, iters=4):
+    """Per-phase device-time breakdown of the ALS iteration (round-2
+    verdict item 1): capture one jax.profiler trace, aggregate the TPU
+    op timeline into gather+gram / solve / copy / scatter / other buckets.
+    Needs the tensorflow xplane protos; returns None when unavailable."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa
+    except Exception:
+        return None
+    import glob
+    import re
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.als import ALSConfig, train_als_prepared
+
+    with tempfile.TemporaryDirectory(prefix="pio_trace_") as td:
+        with jax.profiler.trace(td):
+            cfg = ALSConfig(rank=RANK, iterations=iters, reg=0.01, seed=1)
+            m = train_als_prepared(inputs, cfg)
+            float(jnp.sum(m.user_factors))
+        paths = glob.glob(f"{td}/**/*.xplane.pb", recursive=True)
+        if not paths:
+            return None
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(paths[0], "rb").read())
+        tpu = [p for p in xs.planes if p.name.startswith("/device:TPU")]
+        if not tpu:
+            return None
+        evm = {k: v.name for k, v in tpu[0].event_metadata.items()}
+        phases = {"gather_gram": 0.0, "solve": 0.0, "copy": 0.0,
+                  "scatter_misc": 0.0}
+        for line in tpu[0].lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = evm.get(ev.metadata_id, "")
+                ms = ev.duration_ps / 1e9
+                if name.startswith(("%while", "jit_")):
+                    continue
+                if "ridge_solve" in name:
+                    phases["solve"] += ms
+                elif re.match(r"%fusion", name):
+                    phases["gather_gram"] += ms
+                elif re.match(r"%copy", name):
+                    phases["copy"] += ms
+                else:
+                    phases["scatter_misc"] += ms
+        return {k: round(v / iters, 2) for k, v in phases.items()}
 
 
 def serving_bench():
@@ -204,17 +259,88 @@ def serving_bench():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def ingest_bench(n_single=2000, n_batch=100, batch=50):
+    """Event-server ingest throughput (round-2 verdict item 8c): real
+    HTTP POST /events.json, single and batched, against sqlite-WAL."""
+    try:
+        import concurrent.futures
+        import tempfile
+        import urllib.request
+
+        # ALWAYS a throwaway store — never write benchmark events into a
+        # real PIO_HOME the user has configured.
+        old_home = os.environ.get("PIO_HOME")
+        os.environ["PIO_HOME"] = tempfile.mkdtemp(prefix="pio_ingest_")
+        from predictionio_tpu.data.storage import (
+            App, get_storage, reset_storage,
+        )
+        from predictionio_tpu.data.storage.base import AccessKey
+        from predictionio_tpu.server.event_server import EventServer
+
+        reset_storage()
+        storage = get_storage()
+        app_id = storage.get_apps().insert(App(id=None, name="ingestapp"))
+        storage.get_events().init(app_id)
+        key = storage.get_access_keys().insert(
+            AccessKey.generate(app_id))
+        srv = EventServer(storage, host="127.0.0.1", port=0)
+        srv.start()
+        url = f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}"
+
+        def post(path_url, payload):
+            req = urllib.request.Request(
+                path_url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+
+        def ev(i):
+            return {"event": "rate", "entityType": "user",
+                    "entityId": f"u{i % 997}", "targetEntityType": "item",
+                    "targetEntityId": f"i{i % 4999}",
+                    "properties": {"rating": 1 + i % 5}}
+
+        post(url, ev(0))  # warm
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(ex.map(lambda i: post(url, ev(i)), range(n_single)))
+        single_eps = n_single / (time.perf_counter() - t0)
+        burl = url.replace("/events.json", "/batch/events.json")
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            list(ex.map(
+                lambda b: post(burl, [ev(b * batch + j)
+                                      for j in range(batch)]),
+                range(n_batch)))
+        batch_eps = n_batch * batch / (time.perf_counter() - t0)
+        srv.stop()
+        if old_home is None:
+            os.environ.pop("PIO_HOME", None)
+        else:
+            os.environ["PIO_HOME"] = old_home
+        reset_storage()
+        return {"single_events_per_sec": round(single_eps, 1),
+                "batch_events_per_sec": round(batch_eps, 1)}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     train = train_bench()
     serving = serving_bench()
+    ingest = ingest_bench()
     value = train.pop("value")
     print(json.dumps({
         "metric": "als_train_samples_per_sec_per_chip",
         "value": value,
         "unit": "ratings*iters/sec/chip",
+        # Ratio vs a measured-once Spark-local MLlib ALS figure (no
+        # published upstream number exists — BASELINE.md).  The
+        # hardware-honest metrics are train.mfu_pct and train.phase_ms.
         "vs_baseline": round(value / REF_BASELINE_SAMPLES_PER_SEC, 3),
         "train": train,
         "serving": serving,
+        "ingest": ingest,
     }))
 
 

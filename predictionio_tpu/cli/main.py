@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import datetime as _dt
+import os
 import json
 import logging
 import sys
@@ -240,6 +241,9 @@ def cmd_train(args) -> int:
     from predictionio_tpu.workflow import run_train
 
     initialize_distributed()
+    if getattr(args, "checkpoint_dir", None) and args.checkpoint_every > 0:
+        os.environ["PIO_CHECKPOINT_DIR"] = args.checkpoint_dir
+        os.environ["PIO_CHECKPOINT_EVERY"] = str(args.checkpoint_every)
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -413,6 +417,26 @@ def cmd_adminserver(args) -> int:
     return 0
 
 
+def cmd_storageserver(args) -> int:
+    """Host this process's configured storage over TCP (data/storage/remote.py)
+    so OTHER processes can select it with type=pioserver — the reference's
+    network-storage deployment shape (JDBC/HBase/ES) without their servers."""
+    from predictionio_tpu.data.storage.remote import StorageServer
+
+    srv = StorageServer(_storage(), host=args.ip, port=args.port)
+    srv.start()
+    print(f"Storage server listening on {args.ip}:{srv.port} (Ctrl-C to stop)")
+    print("Clients: PIO_STORAGE_SOURCES_REMOTE_TYPE=pioserver "
+          f"PIO_STORAGE_SOURCES_REMOTE_HOSTS={args.ip} "
+          f"PIO_STORAGE_SOURCES_REMOTE_PORTS={srv.port} "
+          "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=REMOTE")
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import DashboardServer
 
@@ -538,6 +562,12 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train an engine variant")
     t.add_argument("--engine-json", default="engine.json")
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
+                   help="orbax checkpoint root; with --checkpoint-every, a "
+                        "killed train resumes from the last complete step")
+    t.add_argument("--checkpoint-every", dest="checkpoint_every", type=int,
+                   default=0, metavar="N",
+                   help="save every N sweeps/steps (0 = off)")
     t.add_argument("--mesh", default=None, metavar="SPEC",
                    help="device mesh, e.g. 'data=8,model=2' or 'auto' "
                         "(default: env PIO_MESH, else single device)")
@@ -583,6 +613,13 @@ def build_parser() -> argparse.ArgumentParser:
     adm.add_argument("--ip", default="127.0.0.1")
     adm.add_argument("--port", type=int, default=7071)
     adm.set_defaults(fn=cmd_adminserver)
+
+    ss = sub.add_parser("storageserver",
+                        help="serve this PIO_HOME's storage over TCP "
+                             "(clients use type=pioserver)")
+    ss.add_argument("--ip", default="127.0.0.1")
+    ss.add_argument("--port", type=int, default=7077)
+    ss.set_defaults(fn=cmd_storageserver)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
     db.add_argument("--ip", default="127.0.0.1")

@@ -101,6 +101,12 @@ def bind_params(cls: Type[T], data: Optional[Mapping[str, Any]], _path: str = "p
     data = dict(data or {})
     hints = typing.get_type_hints(cls)
     kwargs: Dict[str, Any] = {}
+    # Python-reserved-word aliasing: the reference's engine.json spells
+    # e.g. ALS regParam as "lambda"; the dataclass field is "lambda_".
+    for f in dataclasses.fields(cls):
+        if f.name.endswith("_") and f.name[:-1] in data \
+                and f.name not in data:
+            data[f.name] = data.pop(f.name[:-1])
     for f in dataclasses.fields(cls):
         if f.name in data:
             kwargs[f.name] = _coerce(data.pop(f.name), hints.get(f.name, Any), f"{_path}.{f.name}")

@@ -142,11 +142,39 @@ class _MemoryBackend(_Backend):
     def models(self): return self._models
 
 
+class _PioServerBackend(_Backend):
+    """Out-of-process backend: every repository call forwarded over TCP to
+    a ``pio storageserver`` process (data/storage/remote.py) — the
+    reference's JDBC/HBase/ES network-storage property, selected purely
+    by PIO_STORAGE_* config (HOSTS/PORTS properties)."""
+
+    def __init__(self, source, namespace):
+        super().__init__(source, namespace)
+        from predictionio_tpu.data.storage.remote import RemoteClient
+
+        host = source.properties.get("HOSTS", "127.0.0.1").split(",")[0]
+        port = source.properties.get("PORTS")
+        if not port:
+            raise StorageError(
+                f"pioserver source {source.name} needs a PORTS property.")
+        self._client = RemoteClient(host, int(port.split(",")[0]))
+
+    def events(self): return self._client.events()
+    def apps(self): return self._client.apps()
+    def access_keys(self): return self._client.access_keys()
+    def channels(self): return self._client.channels()
+    def engine_instances(self): return self._client.engine_instances()
+    def evaluation_instances(self): return self._client.evaluation_instances()
+    def models(self): return self._client.models()
+    def close(self): self._client.close()
+
+
 _BACKEND_TYPES: Dict[str, Callable[[StorageSourceConfig, str], _Backend]] = {
     "sqlite": _SQLiteBackend,
     "parquetlog": _ParquetBackend,
     "localfs": _LocalFSBackend,
     "memory": _MemoryBackend,
+    "pioserver": _PioServerBackend,
 }
 
 

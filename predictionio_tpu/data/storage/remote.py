@@ -1,0 +1,398 @@
+"""Out-of-process storage backend — the pluggability proof.
+
+Reference: the JDBC / HBase / Elasticsearch storage plugins (upstream
+``storage/{jdbc,hbase,elasticsearch}/``) whose defining property is that
+the event/metadata/model stores live in ANOTHER PROCESS reached over the
+network, selected purely by ``PIO_STORAGE_*`` configuration.  This module
+supplies both halves:
+
+- :class:`StorageServer` — a TCP daemon hosting any configured local
+  backend (sqlite by default) behind a length-prefixed JSON-RPC protocol.
+  ``pio storageserver`` runs it as the "database process".
+- ``type=pioserver`` backend — client adapters for all seven repository
+  traits (events, apps, access keys, channels, engine/evaluation
+  instances, models) that forward every call over the wire.  Selected
+  with::
+
+      PIO_STORAGE_SOURCES_REMOTE_TYPE=pioserver
+      PIO_STORAGE_SOURCES_REMOTE_HOSTS=127.0.0.1
+      PIO_STORAGE_SOURCES_REMOTE_PORTS=7077
+      PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=REMOTE
+
+Wire format: 4-byte big-endian length + UTF-8 JSON per message.
+Requests are ``{"m": "events.insert", "a": [...], "k": {...}}``; replies
+``{"ok": ...}`` or ``{"err": "...", "storage_error": bool}``.  Values are
+JSON with two tagged encodings: ``{"__dt__": iso8601}`` for datetimes and
+``{"__b64__": ...}`` for byte blobs (model payloads).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
+    EngineInstances, EvaluationInstance, EvaluationInstances, Events, Model,
+    Models, StorageError,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StorageServer", "RemoteClient", "RemoteBackendError"]
+
+
+class RemoteBackendError(StorageError):
+    pass
+
+
+# -- value (de)serialization ------------------------------------------------
+
+_DATACLASSES = {
+    "Event": Event, "App": App, "AccessKey": AccessKey, "Channel": Channel,
+    "EngineInstance": EngineInstance,
+    "EvaluationInstance": EvaluationInstance, "Model": Model,
+}
+
+
+def _enc(v: Any) -> Any:
+    if isinstance(v, _dt.datetime):
+        return {"__dt__": v.isoformat()}
+    if isinstance(v, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, DataMap):
+        return {"__map__": v.to_dict()}
+    if dataclasses.is_dataclass(v) and type(v).__name__ in _DATACLASSES:
+        return {"__dc__": type(v).__name__,
+                "f": {f.name: _enc(getattr(v, f.name))
+                      for f in dataclasses.fields(v)}}
+    if isinstance(v, (list, tuple)):
+        return [_enc(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _enc(x) for k, x in v.items()}
+    return v
+
+
+def _dec(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__dt__" in v:
+            return _dt.datetime.fromisoformat(v["__dt__"])
+        if "__b64__" in v:
+            return base64.b64decode(v["__b64__"])
+        if "__map__" in v:
+            return DataMap(v["__map__"])
+        if "__dc__" in v:
+            cls = _DATACLASSES[v["__dc__"]]
+            fields = {k: _dec(x) for k, x in v["f"].items()}
+            if cls is AccessKey and isinstance(fields.get("events"), list):
+                fields["events"] = tuple(fields["events"])  # JSON drops tuples
+            return cls(**fields)
+        return {k: _dec(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_dec(x) for x in v]
+    return v
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Any:
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            raise ConnectionError("storage server closed the connection")
+        head += chunk
+    (n,) = struct.unpack(">I", head)
+    if n > (256 << 20):
+        raise RemoteBackendError("oversized storage reply")
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("storage server closed mid-reply")
+        buf += chunk
+    return json.loads(bytes(buf))
+
+
+# -- server -----------------------------------------------------------------
+
+# Exact RPC surface per repository — nothing outside this table is
+# callable over the wire (getattr dispatch would otherwise expose
+# private/backing methods).
+_ALLOWED = {
+    "events": {"init", "remove", "insert", "insert_batch", "get", "delete",
+               "find"},
+    "apps": {"insert", "get", "get_by_name", "get_all", "update", "delete"},
+    "access_keys": {"insert", "get", "get_all", "get_by_app_id", "update",
+                    "delete"},
+    "channels": {"_insert", "get", "get_by_app_id", "delete"},
+    "engine_instances": {"insert", "get", "get_all", "get_latest_completed",
+                         "get_completed", "update", "delete"},
+    "evaluation_instances": {"insert", "get", "get_all", "get_completed",
+                             "update", "delete"},
+    "models": {"insert", "get", "delete"},
+}
+
+
+class StorageServer:
+    """Host a local :class:`~predictionio_tpu.data.storage.Storage` (or any
+    object exposing the repository getters) over TCP."""
+
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0):
+        self.storage = storage
+        self._repos = {
+            "events": storage.get_events,
+            "apps": storage.get_apps,
+            "access_keys": storage.get_access_keys,
+            "channels": storage.get_channels,
+            "engine_instances": storage.get_engine_instances,
+            "evaluation_instances": storage.get_evaluation_instances,
+            "models": storage.get_models,
+        }
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        req = _recv(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        result = outer._dispatch(req)
+                        reply = {"ok": _enc(result)}
+                    except StorageError as e:
+                        reply = {"err": str(e), "storage_error": True}
+                    except Exception as e:  # backend bug → client exception
+                        logger.exception("storage RPC failed: %s", req.get("m"))
+                        reply = {"err": f"{type(e).__name__}: {e}",
+                                 "storage_error": False}
+                    try:
+                        _send(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = Server((host, port), Handler)
+        self.host, self.port = self._srv.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, req: Dict) -> Any:
+        repo_name, _, method = req["m"].partition(".")
+        if repo_name not in self._repos or \
+                method not in _ALLOWED.get(repo_name, ()):
+            raise RemoteBackendError(f"unknown storage method {req['m']!r}")
+        repo = self._repos[repo_name]()
+        args = [_dec(a) for a in req.get("a", [])]
+        kwargs = {k: _dec(v) for k, v in req.get("k", {}).items()}
+        out = getattr(repo, method)(*args, **kwargs)
+        if method in ("find",):  # iterator → list on the wire
+            out = list(out)
+        return out
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("storage server on %s:%d", self.host, self.port)
+        return self.port
+
+    def serve_forever(self) -> None:
+        self._srv.serve_forever()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+# -- client -----------------------------------------------------------------
+
+class RemoteClient:
+    """One TCP connection (thread-safe, lazily reconnecting) + adapters."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, method: str, *args, **kwargs) -> Any:
+        req = {"m": method, "a": [_enc(a) for a in args],
+               "k": {k: _enc(v) for k, v in kwargs.items()}}
+        # Transparent resend is only safe for READS: a write may have
+        # executed server-side before the connection dropped, and
+        # re-sending it would duplicate the insert/update.  Writes fail
+        # fast; the next call reconnects.
+        verb = method.split(".", 1)[1] if "." in method else method
+        retriable = verb.startswith(("get", "find"))
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send(self._sock, req)
+                    reply = _recv(self._sock)
+                    break
+                except (ConnectionError, OSError):
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt or not retriable:
+                        raise RemoteBackendError(
+                            f"storage server {self.addr} unreachable "
+                            f"during {method} (write not retried)"
+                            if not retriable else
+                            f"storage server {self.addr} unreachable")
+        if "err" in reply:
+            if reply.get("storage_error"):
+                raise StorageError(reply["err"])
+            raise RemoteBackendError(reply["err"])
+        return _dec(reply["ok"])
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # repo accessors
+    def events(self) -> "RemoteEvents":
+        return RemoteEvents(self)
+
+    def apps(self) -> "RemoteApps":
+        return RemoteApps(self)
+
+    def access_keys(self) -> "RemoteAccessKeys":
+        return RemoteAccessKeys(self)
+
+    def channels(self) -> "RemoteChannels":
+        return RemoteChannels(self)
+
+    def engine_instances(self) -> "RemoteEngineInstances":
+        return RemoteEngineInstances(self)
+
+    def evaluation_instances(self) -> "RemoteEvaluationInstances":
+        return RemoteEvaluationInstances(self)
+
+    def models(self) -> "RemoteModels":
+        return RemoteModels(self)
+
+
+def _forward(repo: str, method: str, iterator: bool = False):
+    def impl(self, *args, **kwargs):
+        out = self._c.call(f"{repo}.{method}", *args, **kwargs)
+        return iter(out) if iterator else out
+    impl.__name__ = method
+    return impl
+
+
+class RemoteEvents(Events):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    init = _forward("events", "init")
+    remove = _forward("events", "remove")
+    insert = _forward("events", "insert")
+    insert_batch = _forward("events", "insert_batch")
+    get = _forward("events", "get")
+    delete = _forward("events", "delete")
+    find = _forward("events", "find", iterator=True)
+
+    def close(self) -> None:
+        self._c.close()
+
+
+class RemoteApps(Apps):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    insert = _forward("apps", "insert")
+    get = _forward("apps", "get")
+    get_by_name = _forward("apps", "get_by_name")
+    get_all = _forward("apps", "get_all")
+    update = _forward("apps", "update")
+    delete = _forward("apps", "delete")
+
+
+class RemoteAccessKeys(AccessKeys):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    insert = _forward("access_keys", "insert")
+    get = _forward("access_keys", "get")
+    get_all = _forward("access_keys", "get_all")
+    get_by_app_id = _forward("access_keys", "get_by_app_id")
+    update = _forward("access_keys", "update")
+    delete = _forward("access_keys", "delete")
+
+
+class RemoteChannels(Channels):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    _insert = _forward("channels", "_insert")
+    get = _forward("channels", "get")
+    get_by_app_id = _forward("channels", "get_by_app_id")
+    delete = _forward("channels", "delete")
+
+
+class RemoteEngineInstances(EngineInstances):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    insert = _forward("engine_instances", "insert")
+    get = _forward("engine_instances", "get")
+    get_all = _forward("engine_instances", "get_all")
+    get_latest_completed = _forward("engine_instances", "get_latest_completed")
+    get_completed = _forward("engine_instances", "get_completed")
+    update = _forward("engine_instances", "update")
+    delete = _forward("engine_instances", "delete")
+
+
+class RemoteEvaluationInstances(EvaluationInstances):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    insert = _forward("evaluation_instances", "insert")
+    get = _forward("evaluation_instances", "get")
+    get_all = _forward("evaluation_instances", "get_all")
+    get_completed = _forward("evaluation_instances", "get_completed")
+    update = _forward("evaluation_instances", "update")
+    delete = _forward("evaluation_instances", "delete")
+
+
+class RemoteModels(Models):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    insert = _forward("models", "insert")
+    get = _forward("models", "get")
+    delete = _forward("models", "delete")

@@ -427,65 +427,6 @@ def prepare_als_inputs(
                      n_items=n_items)
 
 
-def _chunk_device_bucket(arrs, rows_max: int):
-    """Row-chunk an oversized device bucket (HBM guard, device slices)."""
-    idx = arrs[0]
-    r = idx.shape[0]
-    if r <= rows_max:
-        return [arrs]
-    out = []
-    for s in range(0, r, rows_max):
-        e = min(s + rows_max, r)
-        chunk = tuple(a[s:e] for a in arrs)
-        if e - s < rows_max:  # pad the tail chunk to the shared shape
-            short = rows_max - (e - s)
-            idxc, valc, mskc, ridc = chunk
-            chunk = (jnp.pad(idxc, ((0, short), (0, 0))),
-                     jnp.pad(valc, ((0, short), (0, 0))),
-                     jnp.pad(mskc, ((0, short), (0, 0))),
-                     jnp.pad(ridc, (0, short), constant_values=-1))
-        out.append(chunk)
-    return out
-
-
-def _chunk_device_split(split, rows_max: int, pad_rows: int):
-    """Chunk a device split bucket at entity boundaries (cf. host
-    ``_chunk_split_bucket``): segment boundaries come off-device once
-    (tiny), slices stay on device."""
-    idx, vals, msk, seg_ids, ent_ids = split
-    r = idx.shape[0]
-    if r <= rows_max:
-        return [("merged", idx, vals, msk, seg_ids, ent_ids)]
-    seg_np = np.asarray(seg_ids)
-    n_seg = ent_ids.shape[0]
-    seg_starts = np.searchsorted(seg_np, np.arange(n_seg + 1), side="left")
-    out = []
-    e0 = 0
-    while e0 < n_seg:
-        e1 = e0 + 1
-        while e1 < n_seg and seg_starts[e1 + 1] - seg_starts[e0] <= rows_max:
-            e1 += 1
-        r0, r1 = int(seg_starts[e0]), int(seg_starts[e1])
-        if r1 == r0:
-            break
-        n_chunk = e1 - e0
-        row_pad = (-(r1 - r0)) % pad_rows
-        seg_pad = (-n_chunk) % pad_rows
-        seg = jnp.where((seg_ids[r0:r1] >= e0) & (seg_ids[r0:r1] < e1),
-                        seg_ids[r0:r1] - e0, n_chunk + seg_pad)
-        out.append((
-            "merged",
-            jnp.pad(idx[r0:r1], ((0, row_pad), (0, 0))),
-            jnp.pad(vals[r0:r1], ((0, row_pad), (0, 0))),
-            jnp.pad(msk[r0:r1], ((0, row_pad), (0, 0))),
-            jnp.pad(seg.astype(jnp.int32), (0, row_pad),
-                    constant_values=n_chunk + seg_pad),
-            jnp.pad(ent_ids[e0:e1], (0, seg_pad), constant_values=-1),
-        ))
-        e0 = e1
-    return out
-
-
 def _prepare_als_inputs_device(
     user_ids, item_ids, ratings, n_users: int, n_items: int,
     config: ALSConfig,
@@ -518,22 +459,21 @@ def _prepare_als_inputs_device(
     def one_side(rows, cols, n_rows):
         counts = jnp.zeros(n_rows, jnp.int32).at[rows].add(1)
         hist, n_over, n_part = degree_histogram(counts, split_above)
+        over_deg = None
+        if n_over:
+            # Degrees of the over-cap entities in id order — the plan
+            # needs them to place split-chunk boundaries (tiny D2H).
+            ids = jnp.nonzero(counts > split_above, size=n_over)[0]
+            over_deg = np.asarray(counts[ids])
         plan = plan_buckets(hist, n_over, n_part, n_rows,
                             split_above=split_above,
-                            bucket_bounds=config.bucket_bounds)
+                            bucket_bounds=config.bucket_bounds,
+                            max_block_floats=config.max_block_floats,
+                            rank=k, over_degrees=over_deg)
         plain, split = build_buckets(rows, cols, vals, plan)
-        out = []
-        for arrs in plain:
-            l = arrs[0].shape[1]
-            rows_max = max(8, (config.max_block_floats // max(l * k, 1))
-                           // 8 * 8)
-            for chunk in _chunk_device_bucket(arrs, rows_max):
-                out.append(("plain", *chunk))
+        out = [("plain", *chunk) for chunk in plain]
         if split is not None:
-            l = split[0].shape[1]
-            rows_max = max(8, (config.max_block_floats // max(l * k, 1))
-                           // 8 * 8)
-            out.extend(_chunk_device_split(split, rows_max, 8))
+            out.extend(("merged", *chunk) for chunk in split)
         return out
 
     user_buckets = one_side(rows_u, rows_i, n_users)
@@ -551,6 +491,9 @@ def train_als(
     n_items: int,
     config: ALSConfig,
     mesh: Optional[Mesh] = None,
+    *,
+    checkpoint_dir=None,
+    save_every: int = 0,
 ) -> ALSModel:
     """Train from COO triplets.
 
@@ -561,11 +504,23 @@ def train_als(
     """
     inputs = prepare_als_inputs(user_ids, item_ids, ratings, n_users,
                                 n_items, config, mesh)
-    return train_als_prepared(inputs, config)
+    return train_als_prepared(inputs, config, checkpoint_dir=checkpoint_dir,
+                              save_every=save_every)
 
 
-def train_als_prepared(inputs: ALSInputs, config: ALSConfig) -> ALSModel:
-    """The fused iteration loop over pre-built device buckets."""
+def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
+                       checkpoint_dir=None, save_every: int = 0) -> ALSModel:
+    """The fused iteration loop over pre-built device buckets.
+
+    With ``checkpoint_dir`` + ``save_every``, the fori_loop is chunked at
+    sweep granularity and factor state orbax-saved every ``save_every``
+    sweeps; a killed train resumes from the latest complete sweep and —
+    because the loop bound is a traced scalar (one compiled program
+    regardless of chunking) and sweep math is index-independent — the
+    resumed result is bitwise equal to an uninterrupted run
+    (SURVEY.md §5.4: resume is a capability the reference lacks;
+    tests/test_checkpoint_resume.py pins the equality).
+    """
     k = config.rank
     uf, itf = inputs.uf0, inputs.itf0
     user_buckets = inputs.user_buckets
@@ -606,11 +561,30 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig) -> ALSModel:
                     tuple(_bucket_pallas(b[1]) for b in item_buckets))
     ubk = tuple(tuple(b[1:]) for b in user_buckets)
     ibk = tuple(tuple(b[1:]) for b in item_buckets)
-    uf, itf = _train_loop(
-        uf, itf, ubk, ibk, reg, alpha, jnp.int32(config.iterations),
-        kinds=kinds, pallas_flags=pallas_flags,
-        implicit=config.implicit,
-        gram_dtype=_resolve_gram_dtype(config.gram_dtype), solver=solver)
+    gdt = _resolve_gram_dtype(config.gram_dtype)
+
+    def sweeps(uf, itf, n):
+        return _train_loop(
+            uf, itf, ubk, ibk, reg, alpha, jnp.int32(n),
+            kinds=kinds, pallas_flags=pallas_flags,
+            implicit=config.implicit, gram_dtype=gdt, solver=solver)
+
+    if checkpoint_dir and save_every > 0:
+        from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every)
+        done = ckpt.restore_step((uf, itf))
+        if ckpt.restored_state is not None:
+            uf, itf = ckpt.restored_state
+        while done < config.iterations:
+            n = min(save_every, config.iterations - done)
+            uf, itf = sweeps(uf, itf, n)
+            done += n
+            ckpt.maybe_save(done, (uf, itf))
+        ckpt.finalize()
+        ckpt.close()
+    else:
+        uf, itf = sweeps(uf, itf, config.iterations)
     return ALSModel(user_factors=uf, item_factors=itf, rank=k,
                     implicit=config.implicit)
 

@@ -241,30 +241,95 @@ def train(
     labels: np.ndarray,     # [N] {0,1}
     cfg: DLRMConfig,
     mesh: Optional[Mesh] = None,
+    *,
+    checkpoint_dir=None,
+    save_every: int = 0,
+    data_source: str = "auto",
 ) -> DLRMState:
+    """Minibatch CTR training.
+
+    ``data_source`` mirrors two_tower.train: "feeder" streams batches
+    from the native mmap cache (two-field case: cat columns ride the
+    user/item ids, the label rides the value column, dense features ride
+    the v2 extras columns); "numpy" is the host permutation; "auto"
+    picks the feeder when the native library builds and F == 2.
+    ``checkpoint_dir`` + ``save_every`` give mid-training resume with
+    deterministic per-(seed, epoch) batch order in both sources.
+    """
+    from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
+
     n = len(labels)
+    cat = np.asarray(cat)
     cat_global = (np.asarray(cat, np.int64) + cfg.offsets[None, :]).astype(np.int32)
-    rng = np.random.default_rng(cfg.seed)
     state = init_state(cfg, mesh)
+    ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
+                             if checkpoint_dir else 0)
+    start_step = ckpt.restore_step(
+        (state.params, state.opt_state, state.step))
+    if ckpt.restored_state is not None:
+        p, o, s = ckpt.restored_state
+        state = DLRMState(params=p, opt_state=o, step=s)
     bs = cfg.batch_size
     sh = NamedSharding(mesh, P(AXIS_EXPERT)) if mesh is not None else None
-    for _ in range(cfg.epochs):
-        order = rng.permutation(n)
-        for start in range(0, n, bs):
-            sel = order[start:start + bs]
-            pad = bs - len(sel)
-            d = np.concatenate([dense[sel],
-                                np.zeros((pad, cfg.n_dense), np.float32)])
-            c = np.concatenate([cat_global[sel],
-                                np.zeros((pad, cat.shape[1]), np.int32)])
-            y = np.concatenate([labels[sel], np.zeros(pad, np.float32)])
-            w = np.concatenate([np.ones(len(sel), np.float32),
-                                np.zeros(pad, np.float32)])
-            args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
-                    jnp.asarray(y, jnp.float32), jnp.asarray(w)]
-            if sh is not None:
-                args = [jax.device_put(a, sh) for a in args]
-            state, _ = train_step(state, *args, cfg, mesh)
+
+    def numpy_epochs():
+        for epoch in range(cfg.epochs):
+            order = np.random.default_rng(cfg.seed + epoch).permutation(n)
+            for start in range(0, n, bs):
+                sel = order[start:start + bs]
+                yield (dense[sel], cat_global[sel],
+                       labels[sel].astype(np.float32))
+
+    def feeder_epochs():
+        import tempfile
+
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        with tempfile.TemporaryDirectory(prefix="pio_dlrm_cache_") as d:
+            cache = write_cache(
+                f"{d}/train.piof",
+                cat_global[:, 0].astype(np.uint32),
+                cat_global[:, 1].astype(np.uint32),
+                np.asarray(labels, np.float32),
+                extras=np.asarray(dense, np.float32))
+            with EventFeeder(cache, bs, seed=cfg.seed) as f:
+                for _ in range(cfg.epochs):
+                    for u, i, y, extras in f.epoch():
+                        c = np.stack([u.astype(np.int32),
+                                      i.astype(np.int32)], axis=1)
+                        yield extras, c, y
+
+    use_feeder = data_source == "feeder"
+    if use_feeder and cat.shape[1] != 2:
+        raise ValueError(
+            f"data_source='feeder' supports exactly 2 categorical fields "
+            f"(got {cat.shape[1]}); the PIOF1 cache carries them on the "
+            f"user/item id columns. Use data_source='numpy'.")
+    if data_source == "auto":
+        from predictionio_tpu.native.build import load_library
+
+        use_feeder = (cat.shape[1] == 2
+                      and load_library("feeder") is not None)
+    global_step = 0
+    for d, c, y in (feeder_epochs() if use_feeder else numpy_epochs()):
+        global_step += 1
+        if global_step <= start_step:
+            continue  # resume fast-forward: batch already trained
+        pad = bs - len(y)
+        d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
+        c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
+        w = np.concatenate([np.ones(len(y), np.float32),
+                            np.zeros(pad, np.float32)])
+        y = np.concatenate([y, np.zeros(pad, np.float32)])
+        args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
+                jnp.asarray(y, jnp.float32), jnp.asarray(w)]
+        if sh is not None:
+            args = [jax.device_put(a, sh) for a in args]
+        state, _ = train_step(state, *args, cfg, mesh)
+        ckpt.maybe_save(global_step,
+                        (state.params, state.opt_state, state.step))
+    ckpt.finalize()
+    ckpt.close()
     return state
 
 

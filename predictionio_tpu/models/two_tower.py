@@ -202,14 +202,23 @@ def train(
     *,
     checkpoint_dir=None,
     save_every: int = 0,
+    data_source: str = "auto",
 ) -> TwoTowerState:
     """Minibatch training loop over interaction pairs.
 
     The trailing ragged batch is padded with weight-0 rows — fixed shapes,
     one compilation (SURVEY.md §7 recompilation discipline).  With
     ``checkpoint_dir`` + ``save_every``, the loop checkpoints via orbax and
-    resumes mid-epoch after a crash (per-epoch rng streams make batch
-    order reconstructible, so skipped batches are exact).
+    resumes mid-epoch after a crash (deterministic per-epoch shuffles make
+    batch order reconstructible, so skipped batches are exact).
+
+    ``data_source``: "feeder" pulls epochs from the native mmap event
+    cache (native/feeder.cc — batch assembly in C++, off the Python
+    loop); "numpy" keeps host permutation; "auto" uses the feeder when
+    the native library builds.  Both sources cover the dataset exactly
+    once per epoch with a deterministic per-(seed, epoch) shuffle; only
+    the permutation differs (tests/test_native.py pins feeder-vs-numpy
+    training equivalence).
     """
     from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
 
@@ -225,26 +234,50 @@ def train(
         p, o, s = ckpt.restored_state
         state = TwoTowerState(params=p, opt_state=o, step=s)
     bs = cfg.batch_size
-    steps_per_epoch = (n + bs - 1) // bs
     batch_sharding = NamedSharding(mesh, P(AXIS_DATA)) if mesh is not None else None
+
+    def numpy_epochs():
+        for epoch in range(cfg.epochs):
+            order = np.random.default_rng(cfg.seed + epoch).permutation(n)
+            for start in range(0, n, bs):
+                sel = order[start:start + bs]
+                yield user_ids[sel], item_ids[sel], weights[sel]
+
+    def feeder_epochs():
+        import tempfile
+
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        with tempfile.TemporaryDirectory(prefix="pio_tt_cache_") as d:
+            cache = write_cache(f"{d}/train.piof",
+                                np.asarray(user_ids, np.uint32),
+                                np.asarray(item_ids, np.uint32),
+                                np.asarray(weights, np.float32))
+            with EventFeeder(cache, bs, seed=cfg.seed) as f:
+                for _ in range(cfg.epochs):
+                    yield from f.epoch()
+
+    use_feeder = data_source == "feeder"
+    if data_source == "auto":
+        from predictionio_tpu.native.build import load_library
+
+        use_feeder = load_library("feeder") is not None
     global_step = 0
-    for epoch in range(cfg.epochs):
-        order = np.random.default_rng(cfg.seed + epoch).permutation(n)
-        for start in range(0, n, bs):
-            global_step += 1
-            if global_step <= start_step:
-                continue  # resume fast-forward: batch already trained
-            sel = order[start:start + bs]
-            pad = bs - len(sel)
-            u = np.concatenate([user_ids[sel], np.zeros(pad, np.int64)])
-            i = np.concatenate([item_ids[sel], np.zeros(pad, np.int64)])
-            w = np.concatenate([weights[sel], np.zeros(pad, np.float32)])
-            args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
-            if batch_sharding is not None:
-                args = tuple(jax.device_put(a, batch_sharding) for a in args)
-            state, _ = train_step(state, *args, cfg)
-            ckpt.maybe_save(global_step,
-                            (state.params, state.opt_state, state.step))
+    for u, i, w in (feeder_epochs() if use_feeder else numpy_epochs()):
+        global_step += 1
+        if global_step <= start_step:
+            continue  # resume fast-forward: batch already trained
+        pad = bs - len(u)
+        u = np.concatenate([np.asarray(u, np.int64), np.zeros(pad, np.int64)])
+        i = np.concatenate([np.asarray(i, np.int64), np.zeros(pad, np.int64)])
+        w = np.concatenate([np.asarray(w, np.float32),
+                            np.zeros(pad, np.float32)])
+        args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
+        if batch_sharding is not None:
+            args = tuple(jax.device_put(a, batch_sharding) for a in args)
+        state, _ = train_step(state, *args, cfg)
+        ckpt.maybe_save(global_step,
+                        (state.params, state.opt_state, state.step))
     ckpt.finalize()
     ckpt.close()
     return state

@@ -54,6 +54,14 @@ class BucketPlan:
     split_segs: int                  # entity slots (padded)
     n_rows: int                      # entities on this side
     pad_rows_to: int
+    # HBM chunking, decided at plan time so the whole chunked layout is
+    # emitted by ONE jitted program.  (Round-2's eager per-chunk slicing
+    # compiled ~100 distinct tiny XLA programs — with no persistent
+    # compile cache on this backend that alone cost minutes of cold prep.)
+    # plain_chunks[i] = ((row_start, n_rows), ...) within bucket i;
+    # split_chunks  = ((e0, e1, r0, r1), ...) at entity granularity.
+    plain_chunks: Tuple[Tuple[Tuple[int, int], ...], ...] = ()
+    split_chunks: Tuple[Tuple[int, int, int, int], ...] = ()
 
     @property
     def row_starts(self) -> Tuple[int, ...]:
@@ -104,8 +112,18 @@ def plan_buckets(
     split_above: int,
     pad_rows_to: int = 1,
     bucket_bounds="auto",
+    max_block_floats: Optional[int] = None,
+    rank: int = 64,
+    over_degrees: Optional[np.ndarray] = None,
 ) -> BucketPlan:
-    """Degree histogram → static bucket layout (host-side, cheap)."""
+    """Degree histogram → static bucket layout (host-side, cheap).
+
+    ``max_block_floats`` (with ``rank``) turns on HBM chunking: buckets
+    whose gathered [R, L, K] block would exceed the budget are emitted as
+    several row chunks by the device program.  Chunking the split bucket
+    additionally needs ``over_degrees`` — the degrees of the over-cap
+    entities in entity-id order (a tiny D2H).
+    """
     pad_to = max(pad_rows_to, LEN_ALIGN)  # batch dim also sublane-aligned
     degrees = np.arange(len(hist))
     present = degrees[(hist > 0) & (degrees < len(hist))]
@@ -142,10 +160,46 @@ def plan_buckets(
     else:
         split_rows = split_segs = 0
         split_len = None
+
+    def rows_max_for(length: int) -> int:
+        return max(LEN_ALIGN,
+                   (max_block_floats // max(length * rank, 1))
+                   // LEN_ALIGN * LEN_ALIGN)
+
+    plain_chunks: Tuple = ()
+    split_chunks: Tuple = ()
+    if max_block_floats is not None:
+        pc_list = []
+        for b, rp in zip(bounds, rows_padded):
+            rm = rows_max_for(b)
+            ch = []
+            s = 0
+            while s < rp:
+                ch.append((s, min(rm, rp - s)))  # rp, rm multiples of 8
+                s += rm
+            pc_list.append(tuple(ch))
+        plain_chunks = tuple(pc_list)
+        if split_len is not None:
+            assert over_degrees is not None and len(over_degrees) == n_over
+            parts = (np.asarray(over_degrees, np.int64) + split_len - 1) \
+                // split_len
+            starts = np.zeros(n_over + 1, np.int64)
+            np.cumsum(parts, out=starts[1:])
+            rm = rows_max_for(split_len)
+            sc = []
+            e0 = 0
+            while e0 < n_over:
+                e1 = e0 + 1
+                while e1 < n_over and starts[e1 + 1] - starts[e0] <= rm:
+                    e1 += 1
+                sc.append((e0, e1, int(starts[e0]), int(starts[e1])))
+                e0 = e1
+            split_chunks = tuple(sc) if len(sc) > 1 else ()
     return BucketPlan(bounds=bounds, rows=rows, rows_padded=rows_padded,
                       split_len=split_len, split_rows=split_rows,
                       split_segs=split_segs, n_rows=n_rows,
-                      pad_rows_to=pad_to)
+                      pad_rows_to=pad_to, plain_chunks=plain_chunks,
+                      split_chunks=split_chunks)
 
 
 @functools.partial(jax.jit, static_argnames=("plan",))
@@ -260,22 +314,52 @@ def build_buckets(
     for i, (b, rp) in enumerate(zip(plan.bounds, plan.rows_padded)):
         s0 = plan.slot_starts[i]
         r0 = row_starts_pad[i]
-        plain.append((
-            flat_idx[s0:s0 + rp * b].reshape(rp, b),
-            flat_val[s0:s0 + rp * b].reshape(rp, b),
-            flat_msk[s0:s0 + rp * b].reshape(rp, b),
-            flat_row_ids[r0:r0 + rp],
-        ))
+        chunks = plan.plain_chunks[i] if plan.plain_chunks else ((0, rp),)
+        for cs, cn in chunks:
+            plain.append((
+                flat_idx[s0 + cs * b:s0 + (cs + cn) * b].reshape(cn, b),
+                flat_val[s0 + cs * b:s0 + (cs + cn) * b].reshape(cn, b),
+                flat_msk[s0 + cs * b:s0 + (cs + cn) * b].reshape(cn, b),
+                flat_row_ids[r0 + cs:r0 + cs + cn],
+            ))
     split = None
     if plan.split_len is not None:
         s0 = total_plain
         sl = plan.split_len
         pr = plan.split_rows
-        split = (
-            flat_idx[s0:s0 + pr * sl].reshape(pr, sl),
-            flat_val[s0:s0 + pr * sl].reshape(pr, sl),
-            flat_msk[s0:s0 + pr * sl].reshape(pr, sl),
-            seg_ids,
-            ent_of_slot,
-        )
+        if not plan.split_chunks:
+            split = [(
+                flat_idx[s0:s0 + pr * sl].reshape(pr, sl),
+                flat_val[s0:s0 + pr * sl].reshape(pr, sl),
+                flat_msk[s0:s0 + pr * sl].reshape(pr, sl),
+                seg_ids,
+                ent_of_slot,
+            )]
+        else:
+            split = []
+            for e0, e1, r0c, r1c in plan.split_chunks:
+                n_chunk = e1 - e0
+                seg_pad = (-n_chunk) % plan.pad_rows_to
+                row_pad = (-(r1c - r0c)) % plan.pad_rows_to
+                oob = n_chunk + seg_pad  # padding rows → dropped slot
+                seg_c = seg_ids[r0c:r1c]
+                seg_c = jnp.where((seg_c >= e0) & (seg_c < e1),
+                                  seg_c - e0, oob)
+
+                def padrows(a):
+                    return jnp.pad(a, ((0, row_pad),) + ((0, 0),)
+                                   * (a.ndim - 1))
+
+                split.append((
+                    padrows(flat_idx[s0 + r0c * sl:s0 + r1c * sl]
+                            .reshape(r1c - r0c, sl)),
+                    padrows(flat_val[s0 + r0c * sl:s0 + r1c * sl]
+                            .reshape(r1c - r0c, sl)),
+                    padrows(flat_msk[s0 + r0c * sl:s0 + r1c * sl]
+                            .reshape(r1c - r0c, sl)),
+                    jnp.pad(seg_c, (0, row_pad), constant_values=oob),
+                    jnp.pad(ent_of_slot[e0:e1], (0, seg_pad),
+                            constant_values=-1),
+                ))
+        split = tuple(split)
     return tuple(plain), split

@@ -249,6 +249,11 @@ class ALSAlgorithm(Algorithm):
             max_degree=p.maxDegree,
             seed=p.seed if p.seed is not None else ctx.seed,
         )
+        # `pio train --checkpoint-dir D --checkpoint-every N` (or the
+        # PIO_CHECKPOINT_* env pair) makes a killed train resume from the
+        # last complete sweep, bitwise-equal to an uninterrupted run.
+        ck_dir = os.environ.get("PIO_CHECKPOINT_DIR")
+        ck_every = int(os.environ.get("PIO_CHECKPOINT_EVERY", "0") or 0)
         model = als_lib.train_als(
             prepared_data.user_ids,
             prepared_data.item_ids,
@@ -257,6 +262,8 @@ class ALSAlgorithm(Algorithm):
             n_items=len(prepared_data.item_index),
             config=cfg,
             mesh=ctx.mesh,
+            checkpoint_dir=(os.path.join(ck_dir, "als") if ck_dir else None),
+            save_every=ck_every,
         )
         return ALSModelWrapper(
             model=model,

@@ -229,8 +229,11 @@ def run_evaluation(
         if not candidates:
             raise WorkflowError("EngineParamsGenerator produced no candidates.")
         scored: List[Tuple[EngineParams, float, List[float]]] = []
-        for i, engine_params in enumerate(candidates):
-            eval_data = engine.eval(ctx, engine_params)
+        # Shared-prep sweep: folds are read + prepared once per distinct
+        # datasource/preparator config, not once per candidate.
+        all_eval_data = engine.eval_multi(ctx, candidates)
+        for i, (engine_params, eval_data) in enumerate(
+                zip(candidates, all_eval_data)):
             score = evaluation.metric.calculate(eval_data)
             others = [m.calculate(eval_data) for m in evaluation.other_metrics]
             scored.append((engine_params, score, others))

@@ -62,7 +62,8 @@ class TestDeviceBucketEquivalence:
             assert split is None
             return
         hs = host_split[0]
-        didx, dval, dmsk, dseg, dent = [np.asarray(x) for x in split]
+        assert len(split) == 1  # unchunked plan: one merged block
+        didx, dval, dmsk, dseg, dent = [np.asarray(x) for x in split[0]]
         for e_h, ent_id in enumerate(hs.ent_ids):
             if ent_id < 0:
                 continue
@@ -79,7 +80,7 @@ class TestDeviceBucketEquivalence:
         plain, split = _device_side(rows, cols, vals, 400, 64)
         tot = sum(int(np.asarray(p[2]).sum()) for p in plain)
         if split is not None:
-            tot += int(np.asarray(split[2]).sum())
+            tot += sum(int(np.asarray(c[2]).sum()) for c in split)
         assert tot == len(rows)
 
     def test_no_split_when_all_short(self):

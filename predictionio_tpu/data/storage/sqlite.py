@@ -200,21 +200,24 @@ class SQLiteApps(_Repo, base.Apps):
                 return None
 
     def get(self, app_id: int) -> Optional[App]:
-        row = self._conn.execute(
-            f"SELECT id,name,description FROM {self._ns}_apps WHERE id=?", (app_id,)
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT id,name,description FROM {self._ns}_apps WHERE id=?", (app_id,)
+            ).fetchone()
         return App(*row) if row else None
 
     def get_by_name(self, name: str) -> Optional[App]:
-        row = self._conn.execute(
-            f"SELECT id,name,description FROM {self._ns}_apps WHERE name=?", (name,)
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT id,name,description FROM {self._ns}_apps WHERE name=?", (name,)
+            ).fetchone()
         return App(*row) if row else None
 
     def get_all(self) -> List[App]:
-        rows = self._conn.execute(
-            f"SELECT id,name,description FROM {self._ns}_apps ORDER BY id"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT id,name,description FROM {self._ns}_apps ORDER BY id"
+            ).fetchall()
         return [App(*r) for r in rows]
 
     def update(self, app: App) -> bool:
@@ -249,23 +252,26 @@ class SQLiteAccessKeys(_Repo, base.AccessKeys):
         return AccessKey(key=row[0], app_id=row[1], events=tuple(json.loads(row[2])))
 
     def get(self, key: str) -> Optional[AccessKey]:
-        row = self._conn.execute(
-            f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys WHERE accesskey=?",
-            (key,),
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys WHERE accesskey=?",
+                (key,),
+            ).fetchone()
         return self._row_to_key(row) if row else None
 
     def get_all(self) -> List[AccessKey]:
-        rows = self._conn.execute(
-            f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys"
+            ).fetchall()
         return [self._row_to_key(r) for r in rows]
 
     def get_by_app_id(self, app_id: int) -> List[AccessKey]:
-        rows = self._conn.execute(
-            f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys WHERE appid=?",
-            (app_id,),
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT accesskey,appid,events FROM {self._ns}_accesskeys WHERE appid=?",
+                (app_id,),
+            ).fetchall()
         return [self._row_to_key(r) for r in rows]
 
     def update(self, access_key: AccessKey) -> bool:
@@ -298,15 +304,17 @@ class SQLiteChannels(_Repo, base.Channels):
                 return None
 
     def get(self, channel_id: int) -> Optional[Channel]:
-        row = self._conn.execute(
-            f"SELECT id,name,appid FROM {self._ns}_channels WHERE id=?", (channel_id,)
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT id,name,appid FROM {self._ns}_channels WHERE id=?", (channel_id,)
+            ).fetchone()
         return Channel(id=row[0], name=row[1], app_id=row[2]) if row else None
 
     def get_by_app_id(self, app_id: int) -> List[Channel]:
-        rows = self._conn.execute(
-            f"SELECT id,name,appid FROM {self._ns}_channels WHERE appid=?", (app_id,)
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT id,name,appid FROM {self._ns}_channels WHERE appid=?", (app_id,)
+            ).fetchall()
         return [Channel(id=r[0], name=r[1], app_id=r[2]) for r in rows]
 
     def delete(self, channel_id: int) -> bool:
@@ -352,25 +360,28 @@ class SQLiteEngineInstances(_Repo, base.EngineInstances):
         return instance.id
 
     def get(self, instance_id: str) -> Optional[EngineInstance]:
-        row = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_engineinstances WHERE id=?",
-            (instance_id,),
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_engineinstances WHERE id=?",
+                (instance_id,),
+            ).fetchone()
         return self._from_row(row) if row else None
 
     def get_all(self) -> List[EngineInstance]:
-        rows = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_engineinstances ORDER BY starttime DESC"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_engineinstances ORDER BY starttime DESC"
+            ).fetchall()
         return [self._from_row(r) for r in rows]
 
     def get_completed(self, engine_id, engine_version, engine_variant):
-        rows = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_engineinstances "
-            "WHERE status='COMPLETED' AND engineid=? AND engineversion=? AND enginevariant=? "
-            "ORDER BY starttime DESC",
-            (engine_id, engine_version, engine_variant),
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_engineinstances "
+                "WHERE status='COMPLETED' AND engineid=? AND engineversion=? AND enginevariant=? "
+                "ORDER BY starttime DESC",
+                (engine_id, engine_version, engine_variant),
+            ).fetchall()
         return [self._from_row(r) for r in rows]
 
     def get_latest_completed(self, engine_id, engine_version, engine_variant):
@@ -428,23 +439,26 @@ class SQLiteEvaluationInstances(_Repo, base.EvaluationInstances):
         return instance.id
 
     def get(self, instance_id: str) -> Optional[EvaluationInstance]:
-        row = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances WHERE id=?",
-            (instance_id,),
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances WHERE id=?",
+                (instance_id,),
+            ).fetchone()
         return self._from_row(row) if row else None
 
     def get_all(self) -> List[EvaluationInstance]:
-        rows = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances ORDER BY starttime DESC"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances ORDER BY starttime DESC"
+            ).fetchall()
         return [self._from_row(r) for r in rows]
 
     def get_completed(self) -> List[EvaluationInstance]:
-        rows = self._conn.execute(
-            f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances "
-            "WHERE status='EVALCOMPLETED' ORDER BY starttime DESC"
-        ).fetchall()
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_evaluationinstances "
+                "WHERE status='EVALCOMPLETED' ORDER BY starttime DESC"
+            ).fetchall()
         return [self._from_row(r) for r in rows]
 
     def update(self, instance: EvaluationInstance) -> bool:
@@ -475,9 +489,10 @@ class SQLiteModels(_Repo, base.Models):
             )
 
     def get(self, model_id: str) -> Optional[Model]:
-        row = self._conn.execute(
-            f"SELECT id, models FROM {self._ns}_models WHERE id=?", (model_id,)
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT id, models FROM {self._ns}_models WHERE id=?", (model_id,)
+            ).fetchone()
         return Model(id=row[0], models=row[1]) if row else None
 
     def delete(self, model_id: str) -> bool:
@@ -498,10 +513,11 @@ class SQLiteEvents(_Repo, base.Events):
         return True
 
     def _check_init(self, app_id: int, channel_id: Optional[int]) -> None:
-        row = self._conn.execute(
-            f"SELECT 1 FROM {self._ns}_events_inited WHERE appid=? AND channelid IS ?",
-            (app_id, channel_id),
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT 1 FROM {self._ns}_events_inited WHERE appid=? AND channelid IS ?",
+                (app_id, channel_id),
+            ).fetchone()
         if row is None:
             raise base.StorageError(
                 f"Events store for app {app_id} channel {channel_id} not initialized."
@@ -557,10 +573,11 @@ class SQLiteEvents(_Repo, base.Events):
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         self._check_init(app_id, channel_id)
-        row = self._conn.execute(
-            f"SELECT * FROM {self._ns}_events WHERE id=? AND appid=? AND channelid IS ?",
-            (event_id, app_id, channel_id),
-        ).fetchone()
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT * FROM {self._ns}_events WHERE id=? AND appid=? AND channelid IS ?",
+                (event_id, app_id, channel_id),
+            ).fetchone()
         return self._row_to_event(row) if row else None
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -630,7 +647,8 @@ class SQLiteEvents(_Repo, base.Events):
             sql += f" LIMIT {int(limit)}"
         # Materialize eagerly: errors surface at call time (same as the other
         # backends) and no cursor outlives the call.
-        rows = self._conn.execute(sql, params).fetchall()
+        with self._lock:
+            rows = self._conn.execute(sql, params).fetchall()
         return iter([self._row_to_event(r) for r in rows])
 
     def find_columnar(
@@ -658,7 +676,9 @@ class SQLiteEvents(_Repo, base.Events):
             f"WHERE {where} ORDER BY eventtime ASC"
         )
         cols = {f.name: [] for f in base.EVENT_ARROW_SCHEMA}
-        for r in self._conn.execute(sql, params):
+        with self._lock:
+            _matrows = self._conn.execute(sql, params).fetchall()
+        for r in _matrows:
             cols["event_id"].append(r[0])
             cols["event"].append(r[1])
             cols["entity_type"].append(r[2])

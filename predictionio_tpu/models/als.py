@@ -529,12 +529,23 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     alpha = jnp.float32(config.alpha)
     use_pallas = config.use_pallas
     if use_pallas is None:
-        # Default OFF: measured on v5e, XLA fuses the factor gather into
-        # the einsum consumer (no [R,L,K] materialization), which beats the
-        # fused kernel fed from materialized inputs.  The kernel stays
-        # available for explicit opt-in; a gather-inside-kernel variant
-        # (scalar-prefetch indices + per-row DMA) is the follow-up that
-        # could win outright.
+        # Default OFF.  Round-3 measured per-iteration breakdown at the
+        # ML-25M shape (bench.py phase_profile, v5e, 270 ms/iter):
+        #   gather+gram fusions 138 ms   (gather is ROW-RATE limited at
+        #                                 ~0.5-0.8 G rows/s — the wall)
+        #   GJ solve             55 ms   (VPU-bound: ~2K^3 FLOPs x 235k
+        #                                 systems at ~4 TF/s f32)
+        #   layout copies        48 ms   (XLA relayouts of the gathered
+        #                                 bf16 blocks; the Pallas gram
+        #                                 kernel fed the same inputs
+        #                                 measured identical overall)
+        #   scatter/misc         33 ms
+        # Remaining levers, in measured-impact order: (1) a gather whose
+        # output layout feeds the gram without relayout (one flat gather
+        # per side over the prep-time flat slot buffer), (2) halving GJ
+        # work via unrolled shrinking elimination, (3) sub-bf16 gather
+        # rows.  A scalar-loop in-kernel gather measured 0.30 G rows/s —
+        # WORSE than XLA's own engine; don't go back there.
         use_pallas = False
     def _bucket_pallas(idx) -> bool:
         # Jumbo buckets (max-degree outliers) exceed the per-program VMEM

@@ -38,14 +38,19 @@ def pallas_supported() -> bool:
         return False
 
 
-# Per-program VMEM budget: double-buffered input tile must fit comfortably
-# inside ~16 MB/core alongside outputs.  2 × TILE_R × L × K × 4B ≤ 8 MB.
-_VMEM_BUDGET_FLOATS = 1 << 20  # L·K per row
+# Per-program VMEM budget: the double-buffered [TILE_R, L, K] f32 input
+# tile plus w/c blocks, both outputs, and Mosaic's stack share ~16 MB —
+# fits_vmem budgets the tile at ≤ 2 MB (L·K ≤ 65536 at TILE_R=8).
+_VMEM_BUDGET_FLOATS = 1 << 20  # halved again inside fits_vmem
 
 
 def fits_vmem(l: int, k: int) -> bool:
-    """Whether a [TILE_R, l, k] f32 tile double-buffers within VMEM."""
-    return l * k <= _VMEM_BUDGET_FLOATS // TILE_R
+    """Whether a [TILE_R, l, k] f32 tile double-buffers within VMEM.
+
+    Factor 2 on top of the tile itself: the w/c blocks, both outputs and
+    Mosaic's stack allocation share the ~16 MB budget (an L=1776, K=64
+    bucket passed the old guard and overflowed scoped vmem by 388 KB)."""
+    return l * k <= _VMEM_BUDGET_FLOATS // (2 * TILE_R)
 
 
 def fused_gram_vector_xla(f: jax.Array, w: jax.Array, c: jax.Array

@@ -100,11 +100,14 @@ def _barrier_all(*args):
 def _barrier_inputs(inputs, t0):
     import jax.numpy as jnp
 
-    tot = 0.0
+    # ONE fused readback: each float() through the tunnel costs ~80 ms,
+    # and there are ~60 buckets — per-bucket reads would bill ~5 s of
+    # measurement overhead to prep.
+    parts = [inputs.uf0[0, 0]]
     for buckets in (inputs.user_buckets, inputs.item_buckets):
         for _, idx, *rest in buckets:
-            tot += float(jnp.sum(idx[0].astype(jnp.float32)))
-    tot += float(jnp.sum(inputs.uf0[0]))
+            parts.append(idx[0, 0].astype(jnp.float32))
+    float(jnp.sum(jnp.stack(parts)))
     return time.perf_counter() - t0
 
 

@@ -417,6 +417,68 @@ def cmd_adminserver(args) -> int:
     return 0
 
 
+def cmd_template_get(args) -> int:
+    """Reference: `pio template get <gallery-repo> <dir>` scaffolds a new
+    engine from the template gallery.  The rebuild's gallery is the
+    source checkout's examples/<name>; this copies the engine.json +
+    quickstart into the target directory, ready for `pio build` /
+    `pio train`."""
+    import shutil
+
+    gallery = Path(__file__).resolve().parents[2] / "examples"
+    if not gallery.is_dir():
+        # pip wheels ship only predictionio_tpu/*; the scaffold gallery
+        # lives in the source checkout.
+        _die("No template gallery in this installation (pip wheels ship "
+             "only the package) — run from a source checkout, which has "
+             "examples/<template>/engine.json scaffolds.")
+    name = args.template.rstrip("/").split("/")[-1]  # accept repo-ish paths
+    src = gallery / name
+    if not src.is_dir():
+        avail = sorted(d.name for d in gallery.iterdir()
+                       if d.is_dir() and not d.name.startswith("_"))
+        _die(f"Unknown template {name!r}. Available: {', '.join(avail)}")
+    dst = Path(args.directory)
+    if dst.exists() and (not dst.is_dir() or any(dst.iterdir())):
+        _die(f"{dst} exists and is not empty.")
+    shutil.copytree(src, dst, dirs_exist_ok=True)
+    print(f"Template {name!r} copied to {dst}/")
+    for f in sorted(p.name for p in dst.iterdir()):
+        print(f"  {f}")
+    print("Next: edit engine.json (appName), then `pio train` there.")
+    return 0
+
+
+def cmd_shell(args) -> int:
+    """Reference: `pio-shell` (a spark-shell with the pio jars).  Here: a
+    Python REPL with the storage, config, and template modules preloaded."""
+    import code
+
+    from predictionio_tpu import config as pio_config
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    banner = (
+        f"predictionio_tpu shell\n"
+        f"  storage  -> {type(storage).__name__} "
+        f"({storage.config.repositories['METADATA'].source} metadata)\n"
+        f"  apps     -> storage.get_apps().get_all()\n"
+        f"  events   -> storage.get_events()\n"
+        f"Modules: predictionio_tpu (pio), numpy (np), jax, jax.numpy (jnp)"
+    )
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import predictionio_tpu as pio
+
+    code.interact(banner=banner, local={
+        "storage": storage, "pio": pio, "np": np, "jax": jax, "jnp": jnp,
+        "config": pio_config,
+    })
+    return 0
+
+
 def cmd_storageserver(args) -> int:
     """Host this process's configured storage over TCP (data/storage/remote.py)
     so OTHER processes can select it with type=pioserver — the reference's
@@ -613,6 +675,17 @@ def build_parser() -> argparse.ArgumentParser:
     adm.add_argument("--ip", default="127.0.0.1")
     adm.add_argument("--port", type=int, default=7071)
     adm.set_defaults(fn=cmd_adminserver)
+
+    tpl = sub.add_parser("template", help="engine template gallery")
+    tplsub = tpl.add_subparsers(dest="template_cmd", required=True)
+    tg = tplsub.add_parser("get", help="scaffold an engine from a template")
+    tg.add_argument("template", help="template name (e.g. recommendation)")
+    tg.add_argument("directory", help="target directory")
+    tg.set_defaults(fn=cmd_template_get)
+
+    sh = sub.add_parser("shell", help="interactive shell with storage "
+                                      "preloaded (reference: pio-shell)")
+    sh.set_defaults(fn=cmd_shell)
 
     ss = sub.add_parser("storageserver",
                         help="serve this PIO_HOME's storage over TCP "

@@ -111,3 +111,57 @@ def test_channel_lifecycle(clean_storage, capsys):
     assert code == 0 and "mobile" in out
     code, out = run(capsys, "app", "channel-delete", "capp", "mobile")
     assert code == 0
+
+
+def test_template_get_scaffolds(tmp_path, pio_home, capsys):
+    from predictionio_tpu.cli.main import main
+
+    dst = tmp_path / "myengine"
+    rc = main(["template", "get", "recommendation", str(dst)])
+    assert rc == 0
+    assert (dst / "engine.json").exists()
+    out = capsys.readouterr().out
+    assert "recommendation" in out
+
+
+def test_template_get_unknown_lists_available(tmp_path, pio_home, capsys):
+    import pytest
+    from predictionio_tpu.cli.main import main
+
+    with pytest.raises(SystemExit):
+        main(["template", "get", "nosuch", str(tmp_path / "x")])
+    err = capsys.readouterr().err
+    assert "recommendation" in err and "dlrm" in err
+
+
+def test_cli_eval_end_to_end(tmp_path, pio_home, capsys):
+    """`pio eval` drives the shared-prep sweep and writes the evaluation
+    instance + JSON results (reference: RunEvaluation)."""
+    import json as _json
+
+    import numpy as np
+    from predictionio_tpu.cli.main import main
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App, get_storage
+
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    evs = [Event(event="rate", entity_type="user", entity_id=f"u{u % 12}",
+                 target_entity_type="item", target_entity_id=f"i{(u + d) % 8}",
+                 properties=DataMap({"rating": float(1 + d % 5)}))
+           for u in range(12) for d in range(6)]
+    storage.get_events().insert_batch(evs, app_id)
+    out_json = tmp_path / "eval.json"
+    rc = main([
+        "eval",
+        "predictionio_tpu.templates.recommendation.evaluation:evaluation",
+        "predictionio_tpu.templates.recommendation.evaluation:"
+        "default_params_generator",
+        "--output-json", str(out_json),
+    ])
+    assert rc == 0
+    res = _json.loads(out_json.read_text())
+    assert "bestScore" in res and len(res["candidates"]) == 2
+    insts = storage.get_evaluation_instances().get_completed()
+    assert len(insts) == 1

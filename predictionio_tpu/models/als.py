@@ -39,10 +39,11 @@ from predictionio_tpu.ops.pallas_kernels import (
     gj_fits_vmem,
     pallas_supported,
     ridge_solve_gj_pallas,
+    ridge_solve_lu_pallas,
 )
 from predictionio_tpu.ops.ragged import Padded, bucket_by_length
 from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
-from predictionio_tpu.parallel.mesh import AXIS_DATA
+from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
 
 __all__ = ["ALSConfig", "ALSModel", "ALSInputs", "prepare_als_inputs",
            "train_als", "train_als_prepared", "recommend", "predict_scores"]
@@ -73,9 +74,10 @@ class ALSConfig:
     # gather-bound at ML-25M, so "auto" = bfloat16 on TPU, float32
     # elsewhere (CPU tests keep numpy-oracle exactness).
     gram_dtype: str = "auto"
-    # Normal-equation solver: "auto" = Pallas Gauss-Jordan on TPU (the XLA
-    # batched Cholesky is the measured bottleneck of the whole training
-    # loop), Cholesky elsewhere.  "cholesky"/"gj" force a path.
+    # Normal-equation solver: "auto" = the Pallas shrinking-elimination
+    # kernel ("lu") on TPU — the XLA batched Cholesky was the single
+    # largest cost of an iteration and full Gauss-Jordan 1.4x slower
+    # than LU — Cholesky elsewhere.  "cholesky"/"gj"/"lu" force a path.
     solver: str = "auto"
     use_pallas: Optional[bool] = None  # None = auto (on for single-chip TPU)
     # HBM guard: cap the gathered [rows, L, K] block at this many floats;
@@ -180,6 +182,12 @@ def _ridge(a: jax.Array, b: jax.Array, reg_vec: jax.Array,
     K-step while-loop of small dynamic slices runs at ~10 GF/s), so the
     dense-VPU elimination wins despite ~9x the nominal FLOPs.
     """
+    if solver == "lu":
+        # Shrinking elimination: ~K^3/3 FLOPs vs GJ's ~K^3; measured 1.4x
+        # faster at the full-scale solve count (23.5 vs 32.7 ms / 131k
+        # rank-64 systems on v5e).
+        return ridge_solve_lu_pallas(a, b, reg_vec,
+                                     interpret=not pallas_supported())
     if solver == "gj":
         return ridge_solve_gj_pallas(a, b, reg_vec,
                                      interpret=not pallas_supported())
@@ -321,10 +329,14 @@ def _device_buckets(
         if p.split:
             for chunk in _chunk_split_bucket(p, rank, max_block_floats,
                                              pad_rows):
-                arrs = [jnp.asarray(a) for a in chunk]
                 if mesh is not None:
+                    # put_sharded takes the HOST arrays directly — a
+                    # jnp.asarray first would waste a full default-device
+                    # upload (+ download in a multi-host gang).
                     row = NamedSharding(mesh, P(AXIS_DATA))
-                    arrs = [jax.device_put(a, row) for a in arrs]
+                    arrs = [put_sharded(a, mesh, row) for a in chunk]
+                else:
+                    arrs = [jnp.asarray(a) for a in chunk]
                 out.append(("merged", *arrs))
             continue
         r, l = p.indices.shape
@@ -345,11 +357,13 @@ def _device_buckets(
                     rid = np.pad(rid, (0, short), constant_values=-1)
                 chunks.append((idx, vals, msk, rid))
         for idx, vals, msk, rid in chunks:
-            arrs = (jnp.asarray(idx), jnp.asarray(vals),
-                    jnp.asarray(msk), jnp.asarray(rid))
             if mesh is not None:
                 row = NamedSharding(mesh, P(AXIS_DATA))
-                arrs = tuple(jax.device_put(a, row) for a in arrs)
+                arrs = tuple(put_sharded(a, mesh, row)
+                             for a in (idx, vals, msk, rid))
+            else:
+                arrs = (jnp.asarray(idx), jnp.asarray(vals),
+                        jnp.asarray(msk), jnp.asarray(rid))
             out.append(("plain", *arrs))
     return out
 
@@ -405,8 +419,8 @@ def prepare_als_inputs(
     itf = jnp.asarray(rng.standard_normal((n_items, k), dtype=np.float32) / np.sqrt(k))
     if mesh is not None:
         rep = NamedSharding(mesh, P())
-        uf = jax.device_put(uf, rep)
-        itf = jax.device_put(itf, rep)
+        uf = put_sharded(uf, mesh, rep)
+        itf = put_sharded(itf, mesh, rep)
 
     user_buckets = _device_buckets(
         bucket_by_length(user_ids, item_ids, ratings, n_users,
@@ -541,11 +555,11 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
         #                                 measured identical overall)
         #   scatter/misc         33 ms
         # Remaining levers, in measured-impact order: (1) a gather whose
-        # output layout feeds the gram without relayout (one flat gather
-        # per side over the prep-time flat slot buffer), (2) halving GJ
-        # work via unrolled shrinking elimination, (3) sub-bf16 gather
-        # rows.  A scalar-loop in-kernel gather measured 0.30 G rows/s —
-        # WORSE than XLA's own engine; don't go back there.
+        # output layout feeds the gram without relayout (a one-flat-gather
+        # -per-side variant measured WORSE: materialize+slice lost to
+        # XLA's per-bucket fusion), (2) sub-bf16 gather rows.  A
+        # scalar-loop in-kernel gather measured 0.30 G rows/s — worse
+        # than XLA's own engine; don't go back there.
         use_pallas = False
     def _bucket_pallas(idx) -> bool:
         # Jumbo buckets (max-degree outliers) exceed the per-program VMEM
@@ -554,10 +568,10 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
 
     solver = config.solver
     if solver == "auto":
-        # The GJ kernel targets the MXU-adjacent VPU; on CPU meshes the
-        # XLA Cholesky is fine and interpret-mode Pallas would be slow.
+        # The elimination kernels target the VPU; on CPU meshes the XLA
+        # Cholesky is fine and interpret-mode Pallas would be slow.
         # High ranks overflow the kernel's VMEM working set — Cholesky.
-        solver = "gj" if pallas_supported() and gj_fits_vmem(k) \
+        solver = "lu" if pallas_supported() and gj_fits_vmem(k) \
             else "cholesky"
 
     # The WHOLE alternation loop is one jitted program: a fori_loop over

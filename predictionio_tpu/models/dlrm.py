@@ -37,7 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import AXIS_EXPERT
+from predictionio_tpu.parallel.mesh import AXIS_EXPERT, put_sharded
 
 __all__ = ["DLRMConfig", "DLRMState", "init_state", "train_step", "train",
            "predict_proba", "sharded_embedding_lookup"]
@@ -195,7 +195,9 @@ def _tx(cfg: DLRMConfig):
 def init_state(cfg: DLRMConfig, mesh: Optional[Mesh] = None) -> DLRMState:
     params = init_params(cfg)
     if mesh is not None:
-        params = jax.device_put(params, param_shardings(cfg, mesh))
+        params = jax.tree_util.tree_map(
+            lambda p, s_: put_sharded(p, mesh, s_),
+            params, param_shardings(cfg, mesh))
     return DLRMState(params=params, opt_state=_tx(cfg).init(params),
                      step=jnp.zeros((), jnp.int32))
 
@@ -324,7 +326,7 @@ def train(
         args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
                 jnp.asarray(y, jnp.float32), jnp.asarray(w)]
         if sh is not None:
-            args = [jax.device_put(a, sh) for a in args]
+            args = [put_sharded(a, mesh, sh) for a in args]
         state, _ = train_step(state, *args, cfg, mesh)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))

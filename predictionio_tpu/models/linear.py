@@ -21,7 +21,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import AXIS_DATA
+from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
 
 __all__ = ["LogisticRegressionConfig", "LogisticRegressionModel", "train", "predict_proba"]
 
@@ -95,9 +95,9 @@ def train(
     xj = jnp.asarray(xs)
     if mesh is not None:
         sh = NamedSharding(mesh, P(AXIS_DATA))
-        xj = jax.device_put(xj, sh)
-        y_onehot = jax.device_put(y_onehot, sh)
-        w_sample = jax.device_put(w_sample, sh)
+        xj = put_sharded(xj, mesh, sh)
+        y_onehot = put_sharded(y_onehot, mesh, sh)
+        w_sample = put_sharded(w_sample, mesh, sh)
     w0 = jnp.zeros((d, cfg.n_classes), jnp.float32)
     b0 = jnp.zeros((cfg.n_classes,), jnp.float32)
     params, _ = _fit(xj, y_onehot, w_sample, w0, b0,

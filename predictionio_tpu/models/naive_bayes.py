@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import AXIS_DATA
+from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
 
 __all__ = ["NaiveBayesModel", "train_multinomial", "train_gaussian",
            "predict_log_proba"]
@@ -60,8 +60,8 @@ def train_multinomial(
     yj = _one_hot_counts(jnp.asarray(y), n_classes)
     if mesh is not None:
         sh = NamedSharding(mesh, P(AXIS_DATA))
-        xj = jax.device_put(xj, sh)
-        yj = jax.device_put(yj, sh)
+        xj = put_sharded(xj, mesh, sh)
+        yj = put_sharded(yj, mesh, sh)
     class_count, feat_count = _multinomial_stats(xj, yj)
     log_prior = jnp.log(class_count) - jnp.log(jnp.sum(class_count))
     smoothed = feat_count + alpha
@@ -89,8 +89,8 @@ def train_gaussian(
     yj = _one_hot_counts(jnp.asarray(y), n_classes)
     if mesh is not None:
         sh = NamedSharding(mesh, P(AXIS_DATA))
-        xj = jax.device_put(xj, sh)
-        yj = jax.device_put(yj, sh)
+        xj = put_sharded(xj, mesh, sh)
+        yj = put_sharded(yj, mesh, sh)
     n, s1, s2 = _gaussian_stats(xj, yj)
     n_safe = jnp.maximum(n, 1.0)[:, None]
     means = s1 / n_safe

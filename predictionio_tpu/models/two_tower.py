@@ -30,7 +30,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
-from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, put_sharded
 
 __all__ = ["TwoTowerConfig", "TwoTowerState", "init_state", "train_step",
            "train", "encode_users", "encode_items", "retrieve"]
@@ -101,7 +101,9 @@ def _tx(cfg: TwoTowerConfig):
 def init_state(cfg: TwoTowerConfig, mesh: Optional[Mesh] = None) -> TwoTowerState:
     params = init_params(cfg)
     if mesh is not None:
-        params = jax.device_put(params, param_shardings(cfg, mesh))
+        params = jax.tree_util.tree_map(
+            lambda p, sh: put_sharded(p, mesh, sh),
+            params, param_shardings(cfg, mesh))
     opt_state = _tx(cfg).init(params)
     return TwoTowerState(params=params, opt_state=opt_state,
                          step=jnp.zeros((), jnp.int32))
@@ -274,7 +276,8 @@ def train(
                             np.zeros(pad, np.float32)])
         args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
         if batch_sharding is not None:
-            args = tuple(jax.device_put(a, batch_sharding) for a in args)
+            args = tuple(put_sharded(a, mesh, batch_sharding)
+                         for a in args)
         state, _ = train_step(state, *args, cfg)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))

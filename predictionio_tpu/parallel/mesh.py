@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "AXIS_DATA",
+    "put_sharded",
     "AXIS_MODEL",
     "AXIS_SEQUENCE",
     "AXIS_EXPERT",
@@ -160,3 +161,20 @@ def batch_sharding(mesh: Mesh, axis: str = AXIS_DATA) -> NamedSharding:
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully replicated (the reference's ``sc.broadcast`` analogue)."""
     return NamedSharding(mesh, PartitionSpec())
+
+
+def put_sharded(arr, mesh: Mesh, spec) -> "jax.Array":
+    """Place a host array onto the mesh — multi-process safe.
+
+    Single-process this is ``jax.device_put(arr, NamedSharding(mesh,
+    spec))``.  In a multi-host gang (SURVEY §2.5) ``device_put`` of a
+    host array cannot address other processes' devices; every process
+    instead calls this with the SAME full array (data paths here are
+    deterministic from shared inputs) and contributes only its
+    addressable shards via ``make_array_from_callback``.
+    """
+    ns = spec if isinstance(spec, NamedSharding) else NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, ns)
+    a = np.asarray(arr)
+    return jax.make_array_from_callback(a.shape, ns, lambda idx: a[idx])

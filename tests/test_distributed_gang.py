@@ -56,6 +56,26 @@ with mesh:
     got = multihost_utils.global_array_to_host_local_array(
         out, mesh, P("data"))
 assert float(np.asarray(got)[0]) == 3.0, np.asarray(got)
+
+# A REAL distributed train: ALS on the 2-device data mesh across both
+# processes (solve rows sharded, factors replicated).  Every process
+# computes the same input from a shared seed; prepare_als_inputs routes
+# placement through parallel.mesh.put_sharded, which contributes only
+# this process's addressable shards.  Factors must match the meshless
+# single-process computation.
+from predictionio_tpu.models.als import ALSConfig, train_als
+
+drng = np.random.default_rng(7)
+n_u, n_i, n_r = 16, 12, 160
+au = drng.integers(0, n_u, n_r)
+ai = drng.integers(0, n_i, n_r)
+ar = drng.integers(1, 6, n_r).astype(np.float32)
+cfg = ALSConfig(rank=4, iterations=2, seed=0, split_above=64)
+dist_model = train_als(au, ai, ar, n_u, n_i, cfg, mesh=mesh)
+ref_model = train_als(au, ai, ar, n_u, n_i, cfg, mesh=None)
+np.testing.assert_allclose(np.asarray(dist_model.user_factors),
+                           np.asarray(ref_model.user_factors),
+                           rtol=1e-5, atol=1e-6)
 print(f"RANK{rank}_OK", flush=True)
 """
 

@@ -23,8 +23,6 @@ import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.controller import (
@@ -38,7 +36,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
-from predictionio_tpu.ops.topk import top_k_scores
+from predictionio_tpu.ops.topk import host_top_k
 
 __all__ = [
     "Query", "ItemScore", "PredictedResult", "TrainingData",
@@ -236,11 +234,11 @@ class ECommAlgorithm(Algorithm):
 
         uidx = model.user_index.get(query.user)
         if uidx is not None:
-            q = jnp.asarray(model.user_factors[uidx][None, :])
-            scores, ids = top_k_scores(
-                q, jnp.asarray(model.item_factors),
-                min(query.num, n_items), exclude=jnp.asarray(exclude))
-            scores, ids = jax.device_get((scores, ids))  # ONE host transfer
+            # Host fast path: factors are host-resident numpy; a B=1
+            # predict is far below one device dispatch round-trip.
+            scores, ids = host_top_k(
+                model.user_factors[uidx][None, :], model.item_factors,
+                min(query.num, n_items), exclude=exclude)
             pairs = [(float(s), int(i))
                      for s, i in zip(scores[0], ids[0])
                      if s > -1e37]

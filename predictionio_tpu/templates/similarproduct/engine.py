@@ -22,8 +22,6 @@ import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.controller import (
@@ -37,7 +35,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
-from predictionio_tpu.ops.topk import top_k_scores
+from predictionio_tpu.ops.topk import host_top_k
 
 __all__ = [
     "Query", "ItemScore", "PredictedResult", "ViewData", "DataSourceParams",
@@ -160,8 +158,11 @@ class ALSAlgorithm(Algorithm):
                  if i in model.item_index]
         if not known:
             return PredictedResult(itemScores=[])
-        f = jnp.asarray(model.item_factors)
-        q = f[jnp.asarray(known)].sum(axis=0, keepdims=True)  # [1, K]
+        # Host fast path (cf. recommendation template): factors are
+        # host-resident numpy; one matmul row beats a device dispatch
+        # round-trip for any single query.
+        f = model.item_factors
+        q = f[np.asarray(known)].sum(axis=0, keepdims=True)  # [1, K]
 
         n_items = f.shape[0]
         exclude = np.zeros((1, n_items), dtype=bool)
@@ -185,8 +186,7 @@ class ALSAlgorithm(Algorithm):
                     exclude[0, model.item_index[i]] = True
 
         k = min(query.num, n_items)
-        scores, ids = top_k_scores(q, f, k, exclude=jnp.asarray(exclude))
-        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
+        scores, ids = host_top_k(q, f, k, exclude=exclude)
         out = []
         for s, i in zip(scores[0], ids[0]):
             if s <= -1e37:  # ran out of unmasked candidates

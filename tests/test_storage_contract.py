@@ -27,6 +27,22 @@ def ts(s):
     return dt.datetime.fromisoformat(s).replace(tzinfo=UTC)
 
 
+def _hosted(client):
+    """Storage-like adapter exposing one SQLiteClient's repositories to a
+    StorageServer (shared by the remote-backend tests)."""
+
+    class Hosted:
+        get_events = staticmethod(client.events)
+        get_apps = staticmethod(client.apps)
+        get_access_keys = staticmethod(client.access_keys)
+        get_channels = staticmethod(client.channels)
+        get_engine_instances = staticmethod(client.engine_instances)
+        get_evaluation_instances = staticmethod(client.evaluation_instances)
+        get_models = staticmethod(client.models)
+
+    return Hosted
+
+
 # --------------------------------------------------------------------------
 # Events contract
 # --------------------------------------------------------------------------
@@ -41,14 +57,7 @@ def _remote_pair(tmp_path):
 
     client = SQLiteClient(str(tmp_path / "served.db"))
 
-    class Hosted:
-        get_events = staticmethod(client.events)
-        get_apps = staticmethod(client.apps)
-        get_access_keys = staticmethod(client.access_keys)
-        get_channels = staticmethod(client.channels)
-        get_engine_instances = staticmethod(client.engine_instances)
-        get_evaluation_instances = staticmethod(client.evaluation_instances)
-        get_models = staticmethod(client.models)
+    Hosted = _hosted(client)
 
     srv = StorageServer(Hosted, host="127.0.0.1", port=0)
     srv.start()
@@ -376,14 +385,7 @@ def test_pioserver_selected_by_env_alone(pio_home, monkeypatch, tmp_path):
 
     client = SQLiteClient(str(tmp_path / "served.db"))
 
-    class Hosted:
-        get_events = staticmethod(client.events)
-        get_apps = staticmethod(client.apps)
-        get_access_keys = staticmethod(client.access_keys)
-        get_channels = staticmethod(client.channels)
-        get_engine_instances = staticmethod(client.engine_instances)
-        get_evaluation_instances = staticmethod(client.evaluation_instances)
-        get_models = staticmethod(client.models)
+    Hosted = _hosted(client)
 
     srv = StorageServer(Hosted, host="127.0.0.1", port=0)
     srv.start()
@@ -411,3 +413,62 @@ def test_pioserver_selected_by_env_alone(pio_home, monkeypatch, tmp_path):
     finally:
         srv.stop()
         client.close()
+
+
+def test_event_server_over_remote_storage(pio_home, monkeypatch, tmp_path):
+    """Deployment-shaped composition: the EVENT server process keeps its
+    data in a separate STORAGE server process (upstream: event server ->
+    HBase/JDBC).  Ingest over HTTP, verify the bytes landed in the served
+    store, then read back through the event server."""
+    import urllib.request
+
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey
+    from predictionio_tpu.data.storage.remote import StorageServer
+    from predictionio_tpu.data.storage.sqlite import SQLiteClient
+    from predictionio_tpu.server.event_server import EventServer
+
+    backing = SQLiteClient(str(tmp_path / "backing.db"))
+
+    Hosted = _hosted(backing)
+
+    ss = StorageServer(Hosted, host="127.0.0.1", port=0)
+    ss.start()
+    try:
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_REMOTE_TYPE", "pioserver")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_REMOTE_HOSTS", "127.0.0.1")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_REMOTE_PORTS", str(ss.port))
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE",
+                           "REMOTE")
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE",
+                           "REMOTE")
+        storage = Storage()
+        from predictionio_tpu.data.storage.base import App
+
+        app_id = storage.get_apps().insert(App(id=None, name="viaremote"))
+        storage.get_events().init(app_id)
+        key = storage.get_access_keys().insert(AccessKey.generate(app_id))
+        es = EventServer(storage, host="127.0.0.1", port=0)
+        es.start()
+        try:
+            url = (f"http://127.0.0.1:{es.port}/events.json"
+                   f"?accessKey={key}")
+            req = urllib.request.Request(
+                url, data=json.dumps({
+                    "event": "rate", "entityType": "user", "entityId": "u1",
+                    "targetEntityType": "item", "targetEntityId": "i1",
+                    "properties": {"rating": 5}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=20) as r:
+                eid = json.loads(r.read())["eventId"]
+            # The event physically lives in the BACKING sqlite.
+            assert backing.events().get(eid, app_id) is not None
+            with urllib.request.urlopen(url + "&limit=-1", timeout=20) as r:
+                evs = json.loads(r.read())
+            assert len(evs) == 1 and evs[0]["properties"]["rating"] == 5
+        finally:
+            es.stop()
+        storage.close()
+    finally:
+        ss.stop()
+        backing.close()

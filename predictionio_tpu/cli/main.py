@@ -241,9 +241,16 @@ def cmd_train(args) -> int:
     from predictionio_tpu.workflow import run_train
 
     initialize_distributed()
-    if getattr(args, "checkpoint_dir", None) and args.checkpoint_every > 0:
+    if getattr(args, "checkpoint_dir", None):
+        if args.checkpoint_every <= 0:
+            _die("--checkpoint-dir requires --checkpoint-every N (the save "
+                 "cadence); without it no checkpoints would be written and "
+                 "a killed train could not resume.")
         os.environ["PIO_CHECKPOINT_DIR"] = args.checkpoint_dir
         os.environ["PIO_CHECKPOINT_EVERY"] = str(args.checkpoint_every)
+    elif getattr(args, "checkpoint_every", 0) > 0:
+        _die("--checkpoint-every requires --checkpoint-dir DIR (where to "
+             "save); without it no checkpoints would be written.")
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -485,7 +492,9 @@ def cmd_storageserver(args) -> int:
     network-storage deployment shape (JDBC/HBase/ES) without their servers."""
     from predictionio_tpu.data.storage.remote import StorageServer
 
-    srv = StorageServer(_storage(), host=args.ip, port=args.port)
+    secret = args.secret or os.environ.get("PIO_STORAGE_SERVER_SECRET")
+    srv = StorageServer(_storage(), host=args.ip, port=args.port,
+                        secret=secret)
     srv.start()
     print(f"Storage server listening on {args.ip}:{srv.port} (Ctrl-C to stop)")
     print("Clients: PIO_STORAGE_SOURCES_REMOTE_TYPE=pioserver "
@@ -692,6 +701,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "(clients use type=pioserver)")
     ss.add_argument("--ip", default="127.0.0.1")
     ss.add_argument("--port", type=int, default=7077)
+    ss.add_argument("--secret", default=None,
+                    help="shared auth secret clients must present "
+                         "(default: env PIO_STORAGE_SERVER_SECRET); "
+                         "strongly recommended when binding non-loopback")
     ss.set_defaults(fn=cmd_storageserver)
 
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")

@@ -157,7 +157,10 @@ class _PioServerBackend(_Backend):
         if not port:
             raise StorageError(
                 f"pioserver source {source.name} needs a PORTS property.")
-        self._client = RemoteClient(host, int(port.split(",")[0]))
+        self._client = RemoteClient(
+            host, int(port.split(",")[0]),
+            secret=source.properties.get("SECRET"),
+            pool_size=int(source.properties.get("CONNECTIONS", "2")))
 
     def events(self): return self._client.events()
     def apps(self): return self._client.apps()

@@ -24,6 +24,17 @@ Requests are ``{"m": "events.insert", "a": [...], "k": {...}}``; replies
 ``{"ok": ...}`` or ``{"err": "...", "storage_error": bool}``.  Values are
 JSON with two tagged encodings: ``{"__dt__": iso8601}`` for datetimes and
 ``{"__b64__": ...}`` for byte blobs (model payloads).
+
+Scans STREAM: ``events.find_open`` returns the first batch plus a cursor
+token, ``events.find_next`` continues it, ``events.find_close`` abandons
+it — so a 25M-event scan never materializes on either end (the reference's
+JDBC/HBase scans stream the same way).  Cursors live on the connection
+that opened them; the client pins a pooled connection per open scan.
+
+Optional auth: when the server is started with a shared ``secret``, the
+first message on every connection must be ``{"auth": <secret>}`` — anything
+else closes the connection.  Configure clients with a
+``PIO_STORAGE_SOURCES_<NAME>_SECRET`` property.
 """
 
 from __future__ import annotations
@@ -103,12 +114,17 @@ def _dec(v: Any) -> Any:
     return v
 
 
+# Per-message size cap: streamed scan pages stay far below this; only a
+# legacy one-shot ``events.find`` of a huge store could hit it.
+_MAX_MESSAGE = 256 << 20
+
+
 def _send(sock: socket.socket, obj: Any) -> None:
     payload = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-def _recv(sock: socket.socket) -> Any:
+def _recv(sock: socket.socket, max_len: int = 0) -> Any:
     head = b""
     while len(head) < 4:
         chunk = sock.recv(4 - len(head))
@@ -116,7 +132,7 @@ def _recv(sock: socket.socket) -> Any:
             raise ConnectionError("storage server closed the connection")
         head += chunk
     (n,) = struct.unpack(">I", head)
-    if n > (256 << 20):
+    if n > (max_len or _MAX_MESSAGE):
         raise RemoteBackendError("oversized storage reply")
     buf = bytearray()
     while len(buf) < n:
@@ -147,12 +163,24 @@ _ALLOWED = {
 }
 
 
+_FIND_BATCH = 2000  # events per streamed batch (well under the reply cap)
+
+
 class StorageServer:
     """Host a local :class:`~predictionio_tpu.data.storage.Storage` (or any
     object exposing the repository getters) over TCP."""
 
-    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
         self.storage = storage
+        self.secret = secret
+        if secret is None and host not in ("127.0.0.1", "localhost", "::1"):
+            logger.warning(
+                "Storage server binding %s WITHOUT a shared secret: anything "
+                "that can reach this address gets full read/write access to "
+                "every app's events, models, and access keys.  Pass "
+                "secret=... (pio storageserver --secret / "
+                "PIO_STORAGE_SERVER_SECRET).", host)
         self._repos = {
             "events": storage.get_events,
             "apps": storage.get_apps,
@@ -166,13 +194,66 @@ class StorageServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                import hmac
+
+                # Per-connection scan cursors: live iterators keyed by a
+                # monotonic token (never reused within a connection, so a
+                # stale find_next errors instead of silently reading a
+                # later scan's pages); dropped with the connection.
+                cursors: Dict[int, Any] = {"_next": 1}
+                authed = outer.secret is None
+                first = True
                 while True:
                     try:
-                        req = _recv(self.request)
+                        # Pre-auth, a peer knows nothing worth 256 MB: cap
+                        # the first frame of a secured connection at 1 KB
+                        # so strangers can't make the server buffer/parse
+                        # attacker-sized payloads before the secret check.
+                        req = _recv(self.request,
+                                    max_len=(1 << 10) if not authed else 0)
+                    except RemoteBackendError:
+                        # Oversized pre-auth frame — likely a legitimate
+                        # client missing its SECRET property whose first
+                        # RPC was big: tell it why before dropping, so the
+                        # operator doesn't chase a phantom network fault.
+                        try:
+                            _send(self.request, {"err": "auth required",
+                                                 "storage_error": False})
+                        except (ConnectionError, OSError):
+                            pass
+                        return
                     except (ConnectionError, OSError):
                         return
+                    if authed and first and isinstance(req, dict) \
+                            and set(req) == {"auth"}:
+                        # Client configured with a secret, server without
+                        # one: acknowledge the handshake instead of
+                        # dispatching it as an RPC (which would fail with
+                        # a misleading KeyError-shaped reply).
+                        first = False
+                        try:
+                            _send(self.request, {"ok": True})
+                            continue
+                        except (ConnectionError, OSError):
+                            return
+                    first = False
+                    if not authed:
+                        # Compare as bytes: compare_digest raises on
+                        # non-ASCII str inputs.
+                        ok = isinstance(req, dict) and isinstance(
+                            req.get("auth"), str) and hmac.compare_digest(
+                            req["auth"].encode(), outer.secret.encode())
+                        try:
+                            _send(self.request, {"ok": True} if ok else
+                                  {"err": "auth required", "storage_error": False})
+                        except (ConnectionError, OSError):
+                            return
+                        if not ok:
+                            return  # close: no unauthenticated dispatch
+                        authed = True
+                        continue
                     try:
-                        result = outer._dispatch(req)
+                        result = outer._dispatch(req, cursors)
                         reply = {"ok": _enc(result)}
                     except StorageError as e:
                         reply = {"err": str(e), "storage_error": True}
@@ -193,16 +274,46 @@ class StorageServer:
         self.host, self.port = self._srv.server_address
         self._thread: Optional[threading.Thread] = None
 
-    def _dispatch(self, req: Dict) -> Any:
+    @staticmethod
+    def _cursor_page(cursors: Dict[int, Any], cid: int, n: int) -> Dict:
+        it = cursors[cid]
+        batch = []
+        for ev in it:
+            batch.append(ev)
+            if len(batch) >= n:
+                break
+        done = len(batch) < n
+        if done:
+            del cursors[cid]
+        return {"cursor": None if done else cid, "batch": batch,
+                "done": done}
+
+    def _dispatch(self, req: Dict, cursors: Dict[int, Any]) -> Any:
         repo_name, _, method = req["m"].partition(".")
+        args = [_dec(a) for a in req.get("a", [])]
+        kwargs = {k: _dec(v) for k, v in req.get("k", {}).items()}
+        if repo_name == "events" and method in ("find_open", "find_next",
+                                                "find_close"):
+            if method == "find_open":
+                n = int(kwargs.pop("_n", _FIND_BATCH))
+                cid = cursors["_next"]
+                cursors["_next"] = cid + 1
+                cursors[cid] = iter(self._repos["events"]().find(
+                    *args, **kwargs))
+                return self._cursor_page(cursors, cid, n)
+            if method == "find_next":
+                cid, n = int(args[0]), int(args[1])
+                if cid not in cursors:
+                    raise RemoteBackendError(f"unknown scan cursor {cid}")
+                return self._cursor_page(cursors, cid, n)
+            cursors.pop(int(args[0]), None)  # find_close
+            return True
         if repo_name not in self._repos or \
                 method not in _ALLOWED.get(repo_name, ()):
             raise RemoteBackendError(f"unknown storage method {req['m']!r}")
         repo = self._repos[repo_name]()
-        args = [_dec(a) for a in req.get("a", [])]
-        kwargs = {k: _dec(v) for k, v in req.get("k", {}).items()}
         out = getattr(repo, method)(*args, **kwargs)
-        if method in ("find",):  # iterator → list on the wire
+        if method in ("find",):  # iterator → list on the wire (legacy path)
             out = list(out)
         return out
 
@@ -225,19 +336,106 @@ class StorageServer:
 
 # -- client -----------------------------------------------------------------
 
-class RemoteClient:
-    """One TCP connection (thread-safe, lazily reconnecting) + adapters."""
+class _PooledConn:
+    """One lazily-(re)connecting socket; leased exclusively per RPC/scan."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, client: "RemoteClient"):
+        self._client = client
+        self.sock: Optional[socket.socket] = None
+
+    def ensure(self) -> socket.socket:
+        if self.sock is None:
+            self.sock = self._client._connect()
+        return self.sock
+
+    def drop(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+
+class RemoteClient:
+    """A small connection pool (thread-safe, lazily reconnecting) + adapters.
+
+    ``pool_size`` connections run RPCs concurrently instead of serializing
+    every storage call behind one socket lock (round-3 weakness); an open
+    scan pins its connection until the cursor drains.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 secret: Optional[str] = None, pool_size: int = 2):
         self.addr = (host, int(port))
         self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self.secret = secret
+        self._pool_size = max(1, int(pool_size))
+        self._idle: List[_PooledConn] = [_PooledConn(self)
+                                         for _ in range(self._pool_size)]
+        self._pool_lock = threading.Lock()
+        self._closed = False
+
+    def _lease(self) -> _PooledConn:
+        """Take an idle connection, or mint a fresh one when all are busy.
+
+        Never blocks: a thread holding ``pool_size`` pinned scan
+        connections that issues another storage call (nested iteration)
+        must not deadlock waiting on itself — overflow connections are
+        simply closed instead of pooled on release.
+        """
+        with self._pool_lock:
+            if self._idle:
+                return self._idle.pop()
+        return _PooledConn(self)
+
+    def _release(self, conn: _PooledConn) -> None:
+        with self._pool_lock:
+            if not self._closed and len(self._idle) < self._pool_size:
+                self._idle.append(conn)
+                return
+        conn.drop()  # overflow conn, or the client was closed mid-lease
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection(self.addr, timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.secret is not None:
+            _send(s, {"auth": self.secret})
+            reply = _recv(s)
+            if "err" in reply:
+                s.close()
+                raise RemoteBackendError(
+                    f"storage server {self.addr} rejected auth: "
+                    f"{reply['err']}")
         return s
+
+    def _roundtrip(self, conn: _PooledConn, req: Dict, *,
+                   retriable: bool, method: str) -> Any:
+        for attempt in (0, 1):
+            try:
+                sock = conn.ensure()
+                _send(sock, req)
+                reply = _recv(sock)
+                break
+            except (ConnectionError, OSError):
+                conn.drop()
+                if attempt or not retriable:
+                    raise RemoteBackendError(
+                        f"storage server {self.addr} unreachable "
+                        f"during {method} (write not retried)"
+                        if not retriable else
+                        f"storage server {self.addr} unreachable")
+            except RemoteBackendError:
+                # Framing-level failure (e.g. oversized reply): the payload
+                # is still on the wire, so the connection is
+                # protocol-desynchronized — never reuse it.
+                conn.drop()
+                raise
+        if "err" in reply:
+            if reply.get("storage_error"):
+                raise StorageError(reply["err"])
+            raise RemoteBackendError(reply["err"])
+        return _dec(reply["ok"])
 
     def call(self, method: str, *args, **kwargs) -> Any:
         req = {"m": method, "a": [_enc(a) for a in args],
@@ -248,40 +446,60 @@ class RemoteClient:
         # fast; the next call reconnects.
         verb = method.split(".", 1)[1] if "." in method else method
         retriable = verb.startswith(("get", "find"))
-        with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = self._connect()
+        conn = self._lease()
+        try:
+            return self._roundtrip(conn, req, retriable=retriable,
+                                   method=method)
+        finally:
+            self._release(conn)
+
+    def stream_find(self, *args, _batch: int = _FIND_BATCH, **kwargs):
+        """Lazily yield events from a server-side cursor scan.
+
+        The whole scan rides ONE pooled connection (cursors are
+        connection-local server-side); other pool connections stay free
+        for concurrent RPC.  A connection drop mid-scan raises — resuming
+        a half-consumed cursor transparently could silently skip events.
+        """
+        conn = self._lease()
+        page = None
+        try:
+            req = {"m": "events.find_open", "a": [_enc(a) for a in args],
+                   "k": {**{k: _enc(v) for k, v in kwargs.items()},
+                         "_n": _batch}}
+            page = self._roundtrip(conn, req, retriable=True,
+                                   method="events.find_open")
+            while True:
+                yield from page["batch"]
+                if page["done"]:
+                    page = None
+                    return
+                page = self._roundtrip(
+                    conn, {"m": "events.find_next",
+                           "a": [page["cursor"], _batch], "k": {}},
+                    retriable=False, method="events.find_next")
+        finally:
+            if page is not None and not page.get("done", True) \
+                    and conn.sock is not None:
+                # Abandoned mid-scan (caller broke out): free the cursor.
+                # A dropped socket needs no close — its cursors died with
+                # the server-side connection; dialing a fresh connection
+                # just to close a cursor it never had would be wasted.
                 try:
-                    _send(self._sock, req)
-                    reply = _recv(self._sock)
-                    break
-                except (ConnectionError, OSError):
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    if attempt or not retriable:
-                        raise RemoteBackendError(
-                            f"storage server {self.addr} unreachable "
-                            f"during {method} (write not retried)"
-                            if not retriable else
-                            f"storage server {self.addr} unreachable")
-        if "err" in reply:
-            if reply.get("storage_error"):
-                raise StorageError(reply["err"])
-            raise RemoteBackendError(reply["err"])
-        return _dec(reply["ok"])
+                    self._roundtrip(
+                        conn, {"m": "events.find_close",
+                               "a": [page["cursor"]], "k": {}},
+                        retriable=False, method="events.find_close")
+                except (RemoteBackendError, StorageError):
+                    conn.drop()
+            self._release(conn)
 
     def close(self) -> None:
-        with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+        with self._pool_lock:
+            self._closed = True
+            for conn in self._idle:
+                conn.drop()
+            self._idle.clear()
 
     # repo accessors
     def events(self) -> "RemoteEvents":
@@ -324,7 +542,12 @@ class RemoteEvents(Events):
     insert_batch = _forward("events", "insert_batch")
     get = _forward("events", "get")
     delete = _forward("events", "delete")
-    find = _forward("events", "find", iterator=True)
+
+    def find(self, *args, **kwargs):
+        # Streams via server-side cursor pages — never materializes the
+        # scan on either end (the legacy one-shot "events.find" RPC
+        # remains servable for old clients).
+        return self._c.stream_find(*args, **kwargs)
 
     def close(self) -> None:
         self._c.close()

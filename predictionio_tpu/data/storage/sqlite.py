@@ -645,11 +645,37 @@ class SQLiteEvents(_Repo, base.Events):
         )
         if limit is not None and limit >= 0:
             sql += f" LIMIT {int(limit)}"
-        # Materialize eagerly: errors surface at call time (same as the other
-        # backends) and no cursor outlives the call.
-        with self._lock:
-            rows = self._conn.execute(sql, params).fetchall()
-        return iter([self._row_to_event(r) for r in rows])
+        # Lazy batched scan on its OWN connection: a full-store read
+        # (training, streamed remote pages) never materializes every Event
+        # at once, and WAL gives the reader connection snapshot isolation —
+        # concurrent writes through the client's shared connection cannot
+        # make an in-progress scan skip or repeat rows (a cursor on the
+        # SAME connection as the writer has no such guarantee).  Query
+        # errors still surface at call time (execute runs eagerly).
+        if self._c.path == ":memory:":
+            # No second connection can see a :memory: database.
+            with self._lock:
+                rows = self._conn.execute(sql, params).fetchall()
+            return iter([self._row_to_event(r) for r in rows])
+        rc = sqlite3.connect(self._c.path, check_same_thread=False)
+        try:
+            cur = rc.execute(sql, params)
+        except Exception:
+            rc.close()
+            raise
+
+        def gen():
+            try:
+                while True:
+                    rows = cur.fetchmany(1024)
+                    if not rows:
+                        return
+                    for r in rows:
+                        yield self._row_to_event(r)
+            finally:
+                rc.close()
+
+        return gen()
 
     def find_columnar(
         self,

@@ -107,6 +107,24 @@ class ALSModel:
         return {"user_factors": self.user_factors, "item_factors": self.item_factors}
 
 
+def _init_factors(n_users: int, n_items: int, k: int, seed: int):
+    """Deterministic scaled-normal factor init, identical on every backend.
+
+    MLlib uses Xavier-ish normal / sqrt(k).  Both prep paths (host and
+    device-side) MUST share this: jax.random's threefry bits are
+    backend-deterministic, so the same ``ALSConfig.seed`` produces the same
+    model whether prep ran on TPU, CPU, or a mesh (round-3 advisor finding:
+    a numpy init here diverged from the device path's jax.random init,
+    breaking mesh-vs-meshless equivalence on real TPU backends).
+    """
+    key = jax.random.PRNGKey(seed)
+    ku, ki = jax.random.split(key)
+    scale = np.sqrt(k).astype(np.float32)
+    uf = jax.random.normal(ku, (n_users, k), jnp.float32) / scale
+    itf = jax.random.normal(ki, (n_items, k), jnp.float32) / scale
+    return uf, itf
+
+
 def _resolve_gram_dtype(gram_dtype: str) -> str:
     """"auto" → bfloat16 on TPU (gather row-rate win), float32 elsewhere."""
     if gram_dtype == "auto":
@@ -411,12 +429,9 @@ def prepare_als_inputs(
     if use_dev:
         return _prepare_als_inputs_device(user_ids, item_ids, ratings,
                                           n_users, n_items, config)
-    rng = np.random.default_rng(config.seed)
     k = config.rank
     pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
-    # Deterministic scaled-normal init (MLlib uses Xavier-ish normal / sqrt(k)).
-    uf = jnp.asarray(rng.standard_normal((n_users, k), dtype=np.float32) / np.sqrt(k))
-    itf = jnp.asarray(rng.standard_normal((n_items, k), dtype=np.float32) / np.sqrt(k))
+    uf, itf = _init_factors(n_users, n_items, k, config.seed)
     if mesh is not None:
         rep = NamedSharding(mesh, P())
         uf = put_sharded(uf, mesh, rep)
@@ -463,12 +478,7 @@ def _prepare_als_inputs_device(
     else:
         vals = jnp.asarray(ratings, dtype=jnp.float32)
 
-    key = jax.random.PRNGKey(config.seed)
-    ku, ki = jax.random.split(key)
-    uf = (jax.random.normal(ku, (n_users, k), jnp.float32)
-          / np.sqrt(k).astype(np.float32))
-    itf = (jax.random.normal(ki, (n_items, k), jnp.float32)
-           / np.sqrt(k).astype(np.float32))
+    uf, itf = _init_factors(n_users, n_items, k, config.seed)
 
     def one_side(rows, cols, n_rows):
         counts = jnp.zeros(n_rows, jnp.int32).at[rows].add(1)
@@ -597,8 +607,12 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     if checkpoint_dir and save_every > 0:
         from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
 
-        ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every)
-        done = ckpt.restore_step((uf, itf))
+        # Fingerprint = config + data dims: checkpoints from a different
+        # config or a grown dataset are discarded, not resumed into.
+        fp = f"als|{config}|{inputs.n_users}x{inputs.n_items}"
+        ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every,
+                                 fingerprint=fp)
+        done = ckpt.restore_step((uf, itf), total_steps=config.iterations)
         if ckpt.restored_state is not None:
             uf, itf = ckpt.restored_state
         while done < config.iterations:
@@ -606,7 +620,7 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
             uf, itf = sweeps(uf, itf, n)
             done += n
             ckpt.maybe_save(done, (uf, itf))
-        ckpt.finalize()
+        ckpt.complete()
         ckpt.close()
     else:
         uf, itf = sweeps(uf, itf, config.iterations)

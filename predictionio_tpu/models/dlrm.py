@@ -264,10 +264,12 @@ def train(
     cat = np.asarray(cat)
     cat_global = (np.asarray(cat, np.int64) + cfg.offsets[None, :]).astype(np.int32)
     state = init_state(cfg, mesh)
+    total_steps = cfg.epochs * ((n + cfg.batch_size - 1) // cfg.batch_size)
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
-                             if checkpoint_dir else 0)
+                             if checkpoint_dir else 0,
+                             fingerprint=f"dlrm|{cfg}|n={n}")
     start_step = ckpt.restore_step(
-        (state.params, state.opt_state, state.step))
+        (state.params, state.opt_state, state.step), total_steps=total_steps)
     if ckpt.restored_state is not None:
         p, o, s = ckpt.restored_state
         state = DLRMState(params=p, opt_state=o, step=s)
@@ -307,10 +309,16 @@ def train(
             f"data_source='feeder' supports exactly 2 categorical fields "
             f"(got {cat.shape[1]}); the PIOF1 cache carries them on the "
             f"user/item id columns. Use data_source='numpy'.")
+    if use_feeder and cfg.n_dense == 0:
+        raise ValueError(
+            "data_source='feeder' requires n_dense > 0 (the feeder's "
+            "extras columns carry the dense features; with none, epoch() "
+            "yields 3-tuples the DLRM loop cannot consume). "
+            "Use data_source='numpy'.")
     if data_source == "auto":
         from predictionio_tpu.native.build import load_library
 
-        use_feeder = (cat.shape[1] == 2
+        use_feeder = (cat.shape[1] == 2 and cfg.n_dense > 0
                       and load_library("feeder") is not None)
     global_step = 0
     for d, c, y in (feeder_epochs() if use_feeder else numpy_epochs()):
@@ -330,7 +338,7 @@ def train(
         state, _ = train_step(state, *args, cfg, mesh)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))
-    ckpt.finalize()
+    ckpt.complete()
     ckpt.close()
     return state
 

@@ -228,10 +228,12 @@ def train(
     if weights is None:
         weights = np.ones(n, dtype=np.float32)
     state = init_state(cfg, mesh)
+    total_steps = cfg.epochs * ((n + cfg.batch_size - 1) // cfg.batch_size)
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
-                             if checkpoint_dir else 0)
+                             if checkpoint_dir else 0,
+                             fingerprint=f"two_tower|{cfg}|n={n}")
     start_step = ckpt.restore_step(
-        (state.params, state.opt_state, state.step))
+        (state.params, state.opt_state, state.step), total_steps=total_steps)
     if ckpt.restored_state is not None:
         p, o, s = ckpt.restored_state
         state = TwoTowerState(params=p, opt_state=o, step=s)
@@ -281,7 +283,7 @@ def train(
         state, _ = train_step(state, *args, cfg)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))
-    ckpt.finalize()
+    ckpt.complete()
     ckpt.close()
     return state
 

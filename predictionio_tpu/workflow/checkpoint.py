@@ -8,13 +8,14 @@ automatically when a training loop starts over the same directory.
 
 Usage::
 
-    ckpt = TrainCheckpointer(dir, save_every=200)
-    start = ckpt.restore_step(state_like)     # 0 if fresh
+    ckpt = TrainCheckpointer(dir, save_every=200, fingerprint=fp)
+    start = ckpt.restore_step(state_like, total_steps=total)  # 0 if fresh
     state = ckpt.restored_state or state
     for step in range(start, total):
         state, loss = train_step(...)
         ckpt.maybe_save(step + 1, state)
-    ckpt.finalize()
+    ckpt.complete()   # flush AND clear — a finished run leaves no
+    ckpt.close()      # checkpoints behind to stale-resume the next one
 """
 
 from __future__ import annotations
@@ -39,15 +40,19 @@ class TrainCheckpointer:
     same mesh is live.
     """
 
-    def __init__(self, directory, *, save_every: int = 0, keep: int = 3):
+    def __init__(self, directory, *, save_every: int = 0, keep: int = 3,
+                 fingerprint: Optional[str] = None):
         self.directory = Path(directory).absolute()
         self.save_every = int(save_every)
         self.keep = keep
+        self.fingerprint = fingerprint
         self._mgr = None
+        self._discarded = False  # fingerprint mismatch purged stale steps
         self.restored_state: Optional[Any] = None
         if self.enabled:
             import orbax.checkpoint as ocp
 
+            self._check_fingerprint()
             self._mgr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(
@@ -55,20 +60,86 @@ class TrainCheckpointer:
             )
 
     @property
+    def _fingerprint_path(self) -> Path:
+        return self.directory / "fingerprint.txt"
+
+    def _check_fingerprint(self) -> None:
+        """Refuse checkpoints written for a different config/data.
+
+        Resuming a train over checkpoints from a *different* run (config
+        changed, new events ingested) would silently return stale or
+        fast-forwarded factors.  A mismatched fingerprint purges the stale
+        steps so the run starts fresh — loudly.
+        """
+        if self.fingerprint is None:
+            return
+        fp = self._fingerprint_path
+
+        def is_step_dir(c: Path) -> bool:
+            # A digit name alone is not proof: the user may keep an
+            # unrelated "2024/" in the directory they pointed us at.  Real
+            # orbax steps carry the metadata marker (in-flight ones don't
+            # yet — those match only the orbax tmp suffix).
+            return (c.is_dir() and c.name.isdigit()
+                    and (c / "_CHECKPOINT_METADATA").exists())
+
+        has_steps = self.directory.is_dir() and any(
+            is_step_dir(c) for c in self.directory.iterdir())
+        if fp.exists() or has_steps:
+            # Steps with NO fingerprint file (dir written by an older
+            # version, or by a run that didn't fingerprint) are treated as
+            # a mismatch: resuming unvalidated state is the bug this guard
+            # exists to stop.
+            stored = fp.read_text().strip() if fp.exists() else "<absent>"
+            if stored != self.fingerprint:
+                logger.warning(
+                    "Checkpoint dir %s was written for a different "
+                    "config/data (fingerprint %s != %s); discarding stale "
+                    "checkpoints and training from scratch.",
+                    self.directory, stored, self.fingerprint)
+                import shutil
+
+                # Purge ONLY checkpoint artifacts (orbax step dirs are
+                # numeric, in-flight saves end .orbax-checkpoint-tmp) — the
+                # user may have pointed --checkpoint-dir at a directory
+                # holding unrelated files.
+                for child in self.directory.iterdir():
+                    if is_step_dir(child) or (
+                            child.is_dir() and child.name.endswith(
+                                ".orbax-checkpoint-tmp")):
+                        shutil.rmtree(child, ignore_errors=True)
+                if fp.exists():
+                    fp.unlink()
+                self._discarded = True
+        self.directory.mkdir(parents=True, exist_ok=True)
+        fp.write_text(self.fingerprint)
+
+    @property
     def enabled(self) -> bool:
         return self.save_every > 0
 
-    def restore_step(self, state_like: Any) -> int:
+    def restore_step(self, state_like: Any,
+                     total_steps: Optional[int] = None) -> int:
         """Restore the latest checkpoint into ``restored_state``.
 
         ``state_like`` is a live pytree of the right structure (e.g. the
         freshly-initialized state); returns the step to resume FROM (0 when
-        no checkpoint exists).
+        no checkpoint exists).  Pass ``total_steps`` so a checkpoint at or
+        beyond the end of the run — which means the training loop would not
+        execute at all — is flagged loudly.
         """
         if not self.enabled:
             return 0
         import orbax.checkpoint as ocp
 
+        if self._discarded:
+            # The fingerprint mismatch at init is authoritative: any step
+            # visible now is a stale async save from the previous run that
+            # finalized AFTER the purge (its background writer was still
+            # committing when the process reused this directory).
+            for step in list(self._mgr.all_steps()):
+                self._mgr.delete(step)
+            return 0
         latest = self._mgr.latest_step()
         if latest is None:
             return 0
@@ -77,6 +148,14 @@ class TrainCheckpointer:
             latest, args=ocp.args.StandardRestore(abstract))
         logger.info("Resumed training from checkpoint step %d (%s)",
                     latest, self.directory)
+        if total_steps is not None and latest >= total_steps:
+            logger.warning(
+                "Checkpoint step %d >= total training steps %d: the "
+                "training loop will not run and the checkpointed state is "
+                "returned as-is.  If this is a fresh retrain, the previous "
+                "run did not complete cleanly (a completed run clears its "
+                "checkpoints); delete %s to train from scratch.",
+                latest, total_steps, self.directory)
         return int(latest)
 
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -93,9 +172,21 @@ class TrainCheckpointer:
 
             self._mgr.save(step, args=ocp.args.StandardSave(state), force=True)
 
-    def finalize(self) -> None:
-        if self._mgr is not None:
-            self._mgr.wait_until_finished()
+    def complete(self) -> None:
+        """Mark the run finished: flush pending saves, then CLEAR them.
+
+        A completed train persists its final model through the normal model
+        store; leaving mid-train checkpoints behind would make the next
+        retrain over the same directory fast-forward past its loop and
+        silently return the stale factors.
+        """
+        if self._mgr is None:
+            return
+        self._mgr.wait_until_finished()
+        for step in list(self._mgr.all_steps()):
+            self._mgr.delete(step)
+        if self.fingerprint is not None and self._fingerprint_path.exists():
+            self._fingerprint_path.unlink()
 
     def close(self) -> None:
         if self._mgr is not None:

@@ -68,10 +68,10 @@ def test_single_step_matches_oracle(implicit):
                     gram_dtype="float32")
     model = train_als(users, items, ratings, n_users, n_items, cfg)
 
-    # Re-derive the expected first-iteration factors with numpy.
-    rng = np.random.default_rng(7)
-    uf0 = rng.standard_normal((n_users, 4), dtype=np.float32) / 2.0
-    if0 = rng.standard_normal((n_items, 4), dtype=np.float32) / 2.0
+    # Expected first-iteration factors from the shared deterministic init
+    # (the oracle below re-derives the normal-equation math in numpy).
+    from predictionio_tpu.models.als import _init_factors
+    uf0, if0 = (np.asarray(a) for a in _init_factors(n_users, n_items, 4, 7))
     by_user = [(items[users == u], ratings[users == u]) for u in range(n_users)]
     uf1 = _numpy_als_side([i for i, _ in by_user], [v for _, v in by_user],
                           if0.astype(np.float64), 0.1, implicit, 2.0)

@@ -66,17 +66,71 @@ def test_crash_and_resume_equivalence(tmp_path, monkeypatch):
     assert int(resumed.step) == int(expected.step)
 
 
-def test_resume_skips_completed_work(tmp_path):
-    """A finished run's checkpoint makes a re-run a no-op fast-forward."""
+def test_completed_run_clears_checkpoints(tmp_path, monkeypatch):
+    """Completion deletes the checkpoints, so a retrain actually retrains.
+
+    Round-3 advisor (medium): leaving the final-step checkpoint behind made
+    the next `pio train` over the same dir fast-forward past its whole loop
+    and silently return the stale factors.
+    """
     users, items = _data(seed=2)
     cfg = _cfg(seed=11)
-    first = tt.train(users, items, cfg, checkpoint_dir=tmp_path / "ck",
-                     save_every=1)
-    again = tt.train(users, items, cfg, checkpoint_dir=tmp_path / "ck",
-                     save_every=1)
+    ck = tmp_path / "ck"
+    first = tt.train(users, items, cfg, checkpoint_dir=ck, save_every=1)
+    leftover = [p for p in ck.iterdir() if p.name.isdigit()]
+    assert leftover == [], "completed run must clear its checkpoint steps"
+
+    real_step = tt.train_step
+    calls = {"n": 0}
+
+    def counting_step(*args, **kw):
+        calls["n"] += 1
+        return real_step(*args, **kw)
+
+    monkeypatch.setattr(tt, "train_step", counting_step)
+    again = tt.train(users, items, cfg, checkpoint_dir=ck, save_every=1)
+    assert calls["n"] > 0, "retrain over a completed dir must actually train"
     np.testing.assert_allclose(np.asarray(first.params["user_embed"]),
                                np.asarray(again.params["user_embed"]),
                                rtol=1e-7)
+
+
+def test_fingerprint_mismatch_discards_stale_checkpoints(tmp_path, monkeypatch):
+    """Checkpoints from a different config/data are discarded, not resumed."""
+    from predictionio_tpu.models import als as als_lib
+
+    rng = np.random.default_rng(3)
+    users = rng.integers(0, 40, 1200)
+    items = (rng.zipf(1.4, 1200) % 30).astype(np.int64)
+    ratings = rng.integers(1, 6, 1200).astype(np.float32)
+    ck = tmp_path / "als"
+
+    cfg_a = als_lib.ALSConfig(rank=8, iterations=8, reg=0.05, seed=4,
+                              split_above=64)
+    real_loop = als_lib._train_loop
+    calls = {"n": 0}
+
+    def dying_loop(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("injected ALS crash")
+        return real_loop(*args, **kw)
+
+    monkeypatch.setattr(als_lib, "_train_loop", dying_loop)
+    with pytest.raises(RuntimeError, match="injected"):
+        als_lib.train_als(users, items, ratings, 40, 30, cfg_a,
+                          checkpoint_dir=ck, save_every=2)
+    monkeypatch.setattr(als_lib, "_train_loop", real_loop)
+
+    # Retrain with a DIFFERENT config over the same dir: the mid-train
+    # checkpoints above must not leak into this run.
+    cfg_b = als_lib.ALSConfig(rank=8, iterations=6, reg=0.2, seed=5,
+                              split_above=64)
+    plain = als_lib.train_als(users, items, ratings, 40, 30, cfg_b)
+    resumed = als_lib.train_als(users, items, ratings, 40, 30, cfg_b,
+                                checkpoint_dir=ck, save_every=2)
+    np.testing.assert_array_equal(np.asarray(plain.user_factors),
+                                  np.asarray(resumed.user_factors))
 
 
 class TestALSResume:

@@ -472,3 +472,122 @@ def test_event_server_over_remote_storage(pio_home, monkeypatch, tmp_path):
     finally:
         ss.stop()
         backing.close()
+
+
+# --------------------------------------------------------------------------
+# Remote streaming + auth (round-4: cursor-paginated scans, shared secret)
+# --------------------------------------------------------------------------
+
+class TestRemoteStreaming:
+    def test_scan_streams_past_the_reply_cap(self, tmp_path, monkeypatch):
+        """A scan bigger than the per-message cap succeeds because it is
+        cursor-paginated — the legacy one-shot find RPC on the same data
+        blows the cap (round-3 weakness: find materialized everything)."""
+        from predictionio_tpu.data.storage import remote as remote_mod
+
+        remote, cleanup = _remote_pair(tmp_path)
+        try:
+            events = remote.events()
+            events.init(APP)
+            n = 500
+            events.insert_batch(
+                [_mk("rate", f"u{j}", "2024-01-01T00:00:00", target=f"i{j}",
+                     props={"rating": float(j % 5), "pad": "x" * 200})
+                 for j in range(n)], APP)
+            # Cap a message at 64 KB: 500 padded events in one reply far
+            # exceed it, single 50-event pages (~20 KB) do not.
+            monkeypatch.setattr(remote_mod, "_MAX_MESSAGE", 64 << 10)
+            got = list(remote.stream_find(APP, _batch=50))
+            assert len(got) == n
+            assert {e.entity_id for e in got} == {f"u{j}" for j in range(n)}
+            with pytest.raises(StorageError):
+                remote.call("events.find", APP)  # one-shot blows the cap
+        finally:
+            monkeypatch.undo()
+            cleanup()
+
+    def test_abandoned_scan_frees_the_connection(self, tmp_path):
+        remote, cleanup = _remote_pair(tmp_path)
+        try:
+            events = remote.events()
+            events.init(APP)
+            events.insert_batch(
+                [_mk("view", f"u{j}", "2024-01-01T00:00:00")
+                 for j in range(50)], APP)
+            it = remote.stream_find(APP, _batch=10)
+            next(it), next(it)
+            it.close()  # break out mid-scan → find_close + conn back to pool
+            # The pinned connection really went back: the idle pool is full
+            # again (a leak would pass a weaker serve-more-RPCs check,
+            # since _lease mints overflow connections on demand).
+            assert len(remote._idle) == remote._pool_size
+            assert len(list(events.find(APP))) == 50
+            assert len(remote._idle) == remote._pool_size
+        finally:
+            cleanup()
+
+
+class TestRemoteAuth:
+    def _secure_pair(self, tmp_path, server_secret, client_secret):
+        from predictionio_tpu.data.storage.remote import (
+            RemoteClient, StorageServer)
+        from predictionio_tpu.data.storage.sqlite import SQLiteClient
+
+        client = SQLiteClient(str(tmp_path / "served.db"))
+        srv = StorageServer(_hosted(client), host="127.0.0.1", port=0,
+                            secret=server_secret)
+        srv.start()
+        remote = RemoteClient("127.0.0.1", srv.port, secret=client_secret)
+
+        def cleanup():
+            remote.close()
+            srv.stop()
+            client.close()
+
+        return remote, cleanup
+
+    def test_matching_secret_round_trips(self, tmp_path):
+        remote, cleanup = self._secure_pair(tmp_path, "hunter2", "hunter2")
+        try:
+            events = remote.events()
+            events.init(APP)
+            eid = events.insert(
+                _mk("rate", "u1", "2024-01-01T00:00:00", target="i1",
+                    props={"rating": 4}), APP)
+            assert events.get(eid, APP).properties["rating"] == 4
+        finally:
+            cleanup()
+
+    def test_client_secret_against_unsecured_server(self, tmp_path):
+        # Misconfiguration (server started without --secret) must not
+        # produce cryptic RPC failures: the server acks the handshake.
+        remote, cleanup = self._secure_pair(tmp_path, None, "hunter2")
+        try:
+            events = remote.events()
+            events.init(APP)
+            eid = events.insert(
+                _mk("rate", "u1", "2024-01-01T00:00:00", target="i1",
+                    props={"rating": 3}), APP)
+            assert events.get(eid, APP).properties["rating"] == 3
+        finally:
+            cleanup()
+
+    def test_wrong_secret_rejected(self, tmp_path):
+        from predictionio_tpu.data.storage.remote import RemoteBackendError
+
+        remote, cleanup = self._secure_pair(tmp_path, "hunter2", "wrong")
+        try:
+            with pytest.raises(RemoteBackendError, match="auth"):
+                remote.events().get("nope", APP)
+        finally:
+            cleanup()
+
+    def test_missing_secret_rejected(self, tmp_path):
+        from predictionio_tpu.data.storage.remote import RemoteBackendError
+
+        remote, cleanup = self._secure_pair(tmp_path, "hunter2", None)
+        try:
+            with pytest.raises(RemoteBackendError):
+                remote.events().get("nope", APP)
+        finally:
+            cleanup()

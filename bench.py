@@ -22,11 +22,14 @@ entity (Cholesky-equivalent; the GJ kernel's extra arithmetic is not
 credited).  Peak = 197 TF/s (v5e bf16 headline).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-``vs_baseline`` compares against REF_BASELINE_SAMPLES_PER_SEC — a
-measured-once Spark-local MLlib ALS figure of order 1e5 rating-updates/s
-(no published reference number exists, BASELINE.md).  Extra keys record
-MFU, end-to-end time, and the serving benchmark (recs/sec, p50/p99 for
-python + native frontends — BASELINE.md metrics 2-3).
+``vs_baseline`` is the per-iteration speedup vs THIS framework's own
+round-3 measurement (250.4 ms/iter at the full ML-25M shape,
+BENCH_r03.json) — a reproducible yardstick, unlike the earlier ratio
+against a one-off Spark-local MLlib figure no one can re-run (round-3
+verdict item 8; the hardware-honest headline numbers are ``mfu_pct`` and
+``phase_ms``).  Extra keys record MFU, end-to-end time, and the serving
+benchmark (recs/sec, p50/p99 for python + native frontends — BASELINE.md
+metrics 2-3).
 """
 
 import json
@@ -44,7 +47,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                                    ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
-REF_BASELINE_SAMPLES_PER_SEC = 250_000.0  # Spark-local MLlib ALS, ML scale
+# Round-3 per-iteration time at the full ML-25M shape (BENCH_r03.json) —
+# the self-baseline vs_baseline is computed against.  Only meaningful at
+# SCALE=1; smoke runs report vs_baseline=None.
+R3_PER_ITER_MS = 250.39
 PEAK_FLOPS = 197e12  # TPU v5e bf16 headline
 
 SCALE = float(os.environ.get("PIO_BENCH_SCALE", "1.0"))
@@ -270,8 +276,9 @@ def ingest_bench(n_single=2000, n_batch=100, batch=50):
     HTTP POST /events.json, single and batched, against sqlite-WAL."""
     try:
         import concurrent.futures
+        import http.client
         import tempfile
-        import urllib.request
+        import threading
 
         # ALWAYS a throwaway store — never write benchmark events into a
         # real PIO_HOME the user has configured.
@@ -291,14 +298,33 @@ def ingest_bench(n_single=2000, n_batch=100, batch=50):
             AccessKey.generate(app_id))
         srv = EventServer(storage, host="127.0.0.1", port=0)
         srv.start()
-        url = f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}"
+        url = f"/events.json?accessKey={key}"
+        local = threading.local()
 
-        def post(path_url, payload):
-            req = urllib.request.Request(
-                path_url, data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=30) as r:
-                r.read()
+        def post(path, payload):
+            # Persistent per-worker connection: measures the SERVER's
+            # sustained ingest rate, not per-request TCP setup (an
+            # always-on ingest service is driven by keep-alive SDKs).
+            body = json.dumps(payload).encode()
+            for _ in (0, 1):
+                conn = getattr(local, "conn", None)
+                if conn is None:
+                    conn = local.conn = http.client.HTTPConnection(
+                        "127.0.0.1", srv.port, timeout=30)
+                try:
+                    conn.request("POST", path, body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 400:
+                        raise RuntimeError(
+                            f"ingest POST {path.split('?')[0]} -> "
+                            f"{resp.status}")
+                    return
+                except (http.client.HTTPException, OSError):
+                    conn.close()
+                    local.conn = None
+            raise RuntimeError("ingest POST failed twice (connection)")
 
         def ev(i):
             return {"event": "rate", "entityType": "user",
@@ -336,14 +362,17 @@ def main():
     serving = serving_bench()
     ingest = ingest_bench()
     value = train.pop("value")
+    # Self-baseline: speedup over round 3's measured per-iteration time at
+    # the same shape on the same chip (reproducible, unlike the retired
+    # Spark-local constant).  mfu_pct/phase_ms are the absolute metrics.
+    vs = (round(R3_PER_ITER_MS / train["per_iter_ms"], 3)
+          if SCALE == 1.0 and train.get("per_iter_ms") else None)
     print(json.dumps({
         "metric": "als_train_samples_per_sec_per_chip",
         "value": value,
         "unit": "ratings*iters/sec/chip",
-        # Ratio vs a measured-once Spark-local MLlib ALS figure (no
-        # published upstream number exists — BASELINE.md).  The
-        # hardware-honest metrics are train.mfu_pct and train.phase_ms.
-        "vs_baseline": round(value / REF_BASELINE_SAMPLES_PER_SEC, 3),
+        "vs_baseline": vs,
+        "baseline_ref": "r03 per_iter_ms=250.39 @ ML-25M rank64, 1x v5e",
         "train": train,
         "serving": serving,
         "ingest": ingest,

@@ -54,6 +54,11 @@ class SQLiteClient:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._lock = threading.RLock()
+        # Positive (app, channel) init-check cache: the ingest hot path
+        # otherwise pays a SELECT per insert.  In-process only — a remove()
+        # through ANOTHER process is not seen, matching the reference's
+        # per-JVM metadata caching.
+        self._inited_cache: set = set()
         self._ensure_schema()
 
     # -- schema -----------------------------------------------------------
@@ -510,9 +515,12 @@ class SQLiteEvents(_Repo, base.Events):
                 f"INSERT OR IGNORE INTO {self._ns}_events_inited (appid, channelid) VALUES (?,?)",
                 (app_id, channel_id),
             )
+        self._c._inited_cache.add((app_id, channel_id))
         return True
 
     def _check_init(self, app_id: int, channel_id: Optional[int]) -> None:
+        if (app_id, channel_id) in self._c._inited_cache:
+            return
         with self._lock:
             row = self._conn.execute(
                 f"SELECT 1 FROM {self._ns}_events_inited WHERE appid=? AND channelid IS ?",
@@ -522,8 +530,10 @@ class SQLiteEvents(_Repo, base.Events):
             raise base.StorageError(
                 f"Events store for app {app_id} channel {channel_id} not initialized."
             )
+        self._c._inited_cache.add((app_id, channel_id))
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._c._inited_cache.discard((app_id, channel_id))
         with self._lock, self._conn:
             self._conn.execute(
                 f"DELETE FROM {self._ns}_events WHERE appid=? AND channelid IS ?",

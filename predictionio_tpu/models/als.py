@@ -92,6 +92,15 @@ class ALSConfig:
     # ships compact COO once and runs the layout transform as one XLA
     # program.
     device_prep: Union[bool, str] = "auto"
+    # Factor placement on a mesh (SURVEY §2.4 row 2 — the blueprint's
+    # blocked ALS).  "replicated" keeps both factor matrices whole on
+    # every chip (cheapest at ML-25M rank 64: ~57 MB); "sharded"
+    # row-shards the PERSISTENT factor state over the ``data`` axis so it
+    # scales 1/n_chips — XLA inserts the per-sweep gathers (transient,
+    # full-size) and re-shards the solve outputs, riding ICI; "auto"
+    # shards once both matrices exceed ``factor_shard_threshold`` bytes.
+    factor_sharding: str = "auto"
+    factor_shard_threshold: int = 256 << 20
 
 
 @dataclasses.dataclass
@@ -125,6 +134,27 @@ def _init_factors(n_users: int, n_items: int, k: int, seed: int):
     return uf, itf
 
 
+def _shard_factors(config: ALSConfig, n_users: int, n_items: int) -> bool:
+    """Whether a mesh run row-shards the persistent factor matrices."""
+    if config.factor_sharding == "sharded":
+        return True
+    if config.factor_sharding == "replicated":
+        return False
+    if config.factor_sharding != "auto":
+        raise ValueError(
+            f"factor_sharding must be 'auto', 'replicated' or 'sharded' "
+            f"(got {config.factor_sharding!r})")
+    return (n_users + n_items) * config.rank * 4 > config.factor_shard_threshold
+
+
+def _factor_constraint(arr: jax.Array) -> Optional[NamedSharding]:
+    """The sharding to re-impose on factor state each sweep, if blocked."""
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.spec and sh.spec[0] == AXIS_DATA:
+        return sh
+    return None
+
+
 def _resolve_gram_dtype(gram_dtype: str) -> str:
     """"auto" → bfloat16 on TPU (gather row-rate win), float32 elsewhere."""
     if gram_dtype == "auto":
@@ -156,8 +186,15 @@ def _gram_pieces(
         w = m
         cvec = values * m
     if use_pallas:
-        f = factors[indices]                  # [R, L, K] gather, f32
-        a, b = fused_gram_vector_pallas(f, w, cvec)
+        # Gather in gram_dtype (bf16 on TPU: the v5e gather engine is
+        # row-rate limited and bf16 halves the bytes) and feed the fused
+        # kernel DIRECTLY — Pallas consumes the gather's natural K-minor
+        # layout, so no relayout copy is emitted (the einsum path's dots
+        # want L-minor and XLA copies the whole [R,L,K] block to get it:
+        # 47.7 ms/iter at the ML-25M shape, round-3 phase profile).
+        f = factors.astype(gram_dtype)[indices]   # [R, L, K] gather
+        a, b = fused_gram_vector_pallas(f, w, cvec,
+                                        interpret=not pallas_supported())
     else:
         # Gather in gram_dtype: the factor cast is [N, K] (cheap, one pass)
         # and the row-rate-limited gather then moves half the bytes in
@@ -433,9 +470,19 @@ def prepare_als_inputs(
     pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
     if mesh is not None:
-        rep = NamedSharding(mesh, P())
-        uf = put_sharded(uf, mesh, rep)
-        itf = put_sharded(itf, mesh, rep)
+        if _shard_factors(config, n_users, n_items):
+            # Row-shard the persistent state; rows pad to the axis size
+            # (sharded dims must divide).  Padded rows are never gathered
+            # (indices < n) nor scattered to (row_ids < n); the final
+            # model slices them off (train_als_prepared).
+            d = mesh.shape[AXIS_DATA]
+            uf = jnp.pad(uf, ((0, (-n_users) % d), (0, 0)))
+            itf = jnp.pad(itf, ((0, (-n_items) % d), (0, 0)))
+            spec = P(AXIS_DATA, None)
+        else:
+            spec = P()
+        uf = put_sharded(uf, mesh, NamedSharding(mesh, spec))
+        itf = put_sharded(itf, mesh, NamedSharding(mesh, spec))
 
     user_buckets = _device_buckets(
         bucket_by_length(user_ids, item_ids, ratings, n_users,
@@ -553,24 +600,20 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     alpha = jnp.float32(config.alpha)
     use_pallas = config.use_pallas
     if use_pallas is None:
-        # Default OFF.  Round-3 measured per-iteration breakdown at the
-        # ML-25M shape (bench.py phase_profile, v5e, 270 ms/iter):
-        #   gather+gram fusions 138 ms   (gather is ROW-RATE limited at
-        #                                 ~0.5-0.8 G rows/s — the wall)
-        #   GJ solve             55 ms   (VPU-bound: ~2K^3 FLOPs x 235k
-        #                                 systems at ~4 TF/s f32)
-        #   layout copies        48 ms   (XLA relayouts of the gathered
-        #                                 bf16 blocks; the Pallas gram
-        #                                 kernel fed the same inputs
-        #                                 measured identical overall)
-        #   scatter/misc         33 ms
-        # Remaining levers, in measured-impact order: (1) a gather whose
-        # output layout feeds the gram without relayout (a one-flat-gather
-        # -per-side variant measured WORSE: materialize+slice lost to
-        # XLA's per-bucket fusion), (2) sub-bf16 gather rows.  A
-        # scalar-loop in-kernel gather measured 0.30 G rows/s — worse
-        # than XLA's own engine; don't go back there.
-        use_pallas = False
+        # Default ON for TPU (round 4).  Round-3 measured the einsum path
+        # at 250 ms/iter (ML-25M shape): gather+gram 138, solve 32.5,
+        # layout copies 47.7, scatter/misc 33.  The copies were XLA
+        # relayouting every gathered [R,L,K] block from the gather's
+        # K-minor layout to the L-minor layout the gram dots want, and
+        # A relayouts feeding the lanes-solve.  The round-4 kernels
+        # consume/emit natural layouts end to end (gather → fused gram →
+        # in-kernel-transposing solve → scatter), which removes those
+        # copies; the earlier "Pallas measured identical" result came
+        # from the old kernel's materialized f32 cast of the gathered
+        # block, which cost what the copy cost.  (A scalar-loop in-kernel
+        # gather measured 0.30 G rows/s — worse than XLA's own engine;
+        # don't go back there.)
+        use_pallas = pallas_supported()
     def _bucket_pallas(idx) -> bool:
         # Jumbo buckets (max-degree outliers) exceed the per-program VMEM
         # tile budget — those take the einsum path.
@@ -598,11 +641,17 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     ibk = tuple(tuple(b[1:]) for b in item_buckets)
     gdt = _resolve_gram_dtype(config.gram_dtype)
 
+    # Blocked (factor-sharded) mode: re-impose the row-sharding on the
+    # carry each sweep so GSPMD keeps the persistent state sharded instead
+    # of silently replicating it after the scatter.
+    factor_shardings = (_factor_constraint(uf), _factor_constraint(itf))
+
     def sweeps(uf, itf, n):
         return _train_loop(
             uf, itf, ubk, ibk, reg, alpha, jnp.int32(n),
             kinds=kinds, pallas_flags=pallas_flags,
-            implicit=config.implicit, gram_dtype=gdt, solver=solver)
+            implicit=config.implicit, gram_dtype=gdt, solver=solver,
+            factor_shardings=factor_shardings)
 
     if checkpoint_dir and save_every > 0:
         from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
@@ -624,14 +673,22 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
         ckpt.close()
     else:
         uf, itf = sweeps(uf, itf, config.iterations)
+    # Blocked mode pads factor rows to the mesh axis size; the model keeps
+    # the true extents.
+    if uf.shape[0] != inputs.n_users:
+        uf = uf[:inputs.n_users]
+    if itf.shape[0] != inputs.n_items:
+        itf = itf[:inputs.n_items]
     return ALSModel(user_factors=uf, item_factors=itf, rank=k,
                     implicit=config.implicit)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "kinds", "pallas_flags", "implicit", "gram_dtype", "solver"))
+    "kinds", "pallas_flags", "implicit", "gram_dtype", "solver",
+    "factor_shardings"))
 def _train_loop(uf0, itf0, user_buckets, item_buckets, reg, alpha, iterations,
-                *, kinds, pallas_flags, implicit, gram_dtype, solver):
+                *, kinds, pallas_flags, implicit, gram_dtype, solver,
+                factor_shardings=(None, None)):
     # ``iterations`` is a traced scalar on purpose: the fori_loop bound being
     # dynamic means warmup (1 iter) and the real run (N iters) share one
     # compiled program.
@@ -654,10 +711,15 @@ def _train_loop(uf0, itf0, user_buckets, item_buckets, reg, alpha, iterations,
                 dst = _scatter_rows(dst, rid, solved)
         return dst
 
+    def constrain(x, s):
+        return jax.lax.with_sharding_constraint(x, s) if s is not None else x
+
     def body(_, carry):
         uf, itf = carry
-        uf = side(user_buckets, kinds[0], pallas_flags[0], uf, itf)
-        itf = side(item_buckets, kinds[1], pallas_flags[1], itf, uf)
+        uf = constrain(side(user_buckets, kinds[0], pallas_flags[0], uf, itf),
+                       factor_shardings[0])
+        itf = constrain(side(item_buckets, kinds[1], pallas_flags[1], itf, uf),
+                        factor_shardings[1])
         return (uf, itf)
 
     return jax.lax.fori_loop(0, iterations, body, (uf0, itf0))

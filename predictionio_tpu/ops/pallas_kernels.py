@@ -45,12 +45,13 @@ _VMEM_BUDGET_FLOATS = 1 << 20  # halved again inside fits_vmem
 
 
 def fits_vmem(l: int, k: int) -> bool:
-    """Whether a [TILE_R, l, k] f32 tile double-buffers within VMEM.
+    """Whether the fused gram kernel can handle a [*, l, k] bucket.
 
-    Factor 2 on top of the tile itself: the w/c blocks, both outputs and
-    Mosaic's stack allocation share the ~16 MB budget (an L=1776, K=64
-    bucket passed the old guard and overflowed scoped vmem by 388 KB)."""
-    return l * k <= _VMEM_BUDGET_FLOATS // (2 * TILE_R)
+    Since the L-chunked grid (round 4), any bucket length fits — the
+    staged tile is at most [TILE_R, _L_CHUNK·64/k, k].  Only the rank
+    bounds the working set (the [TILE_R, k, k] f32 accumulator)."""
+    del l
+    return k <= 256
 
 
 def fused_gram_vector_xla(f: jax.Array, w: jax.Array, c: jax.Array
@@ -63,23 +64,69 @@ def fused_gram_vector_xla(f: jax.Array, w: jax.Array, c: jax.Array
     return a, b
 
 
-TILE_R = 8  # rows per program — TPU sublane granularity for f32
+TILE_R = 8     # rows per program — TPU sublane granularity for f32
+_L_CHUNK = 1024  # max slots staged per grid step (VMEM tile bound)
 
 
-def _kernel(f_ref, w_ref, c_ref, a_ref, b_ref):
-    # f: [TILE_R, L, K] in VMEM; w/c: [TILE_R, L].  Static 8-row unroll of
-    # plain 2-D MXU dots — Mosaic lowers these directly (the batched 3-D
-    # dot_general form does not lower).
-    for r in range(TILE_R):
-        f = f_ref[r]                              # [L, K]
-        fw = f * w_ref[r][:, None]                # VPU
-        a_ref[r] = jax.lax.dot_general(           # MXU: [K,L]·[L,K]
-            fw, f, dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        b_ref[r] = jax.lax.dot_general(           # MXU: [1,L]·[L,K]
-            c_ref[r][None, :], f,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)[0]
+def _gram_kernel(f_ref, w_ref, c_ref, a_ref, b_ref, *, l_real: int,
+                 l_chunk: int):
+    """One (row-tile, L-chunk) grid step of the fused (A, b) build.
+
+    ``f`` arrives in the gather's NATURAL layout and dtype — bf16,
+    K-minor — so XLA inserts NO relayout copy between the gather and this
+    kernel (round-3's 47 ms/iter copy phase was exactly that relayout).
+    The kernel accumulates both outputs in f32 across L-chunks; the final
+    chunk of a non-multiple L masks the over-read tail (Pallas pads OOB
+    block loads with unspecified values — a NaN there would poison the
+    accumulation through 0·NaN).
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        a_ref[:] = jnp.zeros_like(a_ref)
+        b_ref[:] = jnp.zeros_like(b_ref)
+
+    n_chunks = pl.num_programs(1)
+    partial_tail = l_real % l_chunk != 0
+
+    def accumulate(masked: bool):
+        for r in range(TILE_R):
+            f = f_ref[r]                              # [LC, K] bf16
+            w = w_ref[r]                              # [LC] f32
+            c = c_ref[r]
+            if masked:
+                # Masks built at their target ranks: Mosaic cannot insert
+                # a minor dim on an i1 vector.
+                off = j * l_chunk
+                valid1 = (jax.lax.broadcasted_iota(
+                    jnp.int32, (l_chunk,), 0) + off) < l_real
+                valid2 = (jax.lax.broadcasted_iota(
+                    jnp.int32, (l_chunk, 1), 0) + off) < l_real
+                w = jnp.where(valid1, w, 0.0)
+                c = jnp.where(valid1, c, 0.0)
+                f = jnp.where(valid2, f, jnp.zeros((), f.dtype))
+            # Reshape to 2-D in f32 BEFORE the dtype cast: Mosaic only
+            # supports minor-dim insertion on 32-bit vectors.
+            fw = f * w[:, None].astype(f.dtype)       # VPU
+            a_ref[r] += jax.lax.dot_general(          # MXU: [K,L]·[L,K]
+                fw, f, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            b_ref[r] += jax.lax.dot_general(          # MXU: [1,L]·[L,K]
+                c[None, :].astype(f.dtype), f,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)[0]
+
+    if partial_tail:
+        @pl.when(j == n_chunks - 1)
+        def _tail():
+            accumulate(masked=True)
+
+        @pl.when(j < n_chunks - 1)
+        def _body():
+            accumulate(masked=False)
+    else:
+        accumulate(masked=False)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -88,9 +135,10 @@ def fused_gram_vector_pallas(f: jax.Array, w: jax.Array, c: jax.Array,
                              ) -> Tuple[jax.Array, jax.Array]:
     """Fused (A, b) build — one VMEM pass over the gathered factors.
 
-    Rows are padded up to the TILE_R sublane granule; padding rows compute
-    garbage that is sliced off (their weights are whatever padding holds —
-    never read).
+    Accepts ``f`` in any float dtype (bf16 keeps the gather at its
+    measured row-rate AND avoids a materialized f32 convert); rows are
+    padded up to the TILE_R sublane granule (padding rows compute garbage
+    that is sliced off), L is chunked so any bucket length fits VMEM.
     """
     r, l, k = f.shape
     r_pad = (-r) % TILE_R
@@ -99,25 +147,29 @@ def fused_gram_vector_pallas(f: jax.Array, w: jax.Array, c: jax.Array,
         w = jnp.pad(w, ((0, r_pad), (0, 0)))
         c = jnp.pad(c, ((0, r_pad), (0, 0)))
     rp = r + r_pad
-    grid = (rp // TILE_R,)
+    # Chunk length scales inversely with rank to hold the staged tile at
+    # ~[TILE_R, 1024, 64]-equivalent bytes.
+    lc = min(l, max(128, _L_CHUNK * 64 // max(k, 1)))
+    n_chunks = -(-l // lc)
+    kernel = functools.partial(_gram_kernel, l_real=l, l_chunk=lc)
     a, b = pl.pallas_call(
-        _kernel,
-        grid=grid,
+        kernel,
+        grid=(rp // TILE_R, n_chunks),
         in_specs=[
-            pl.BlockSpec((TILE_R, l, k), lambda i: (i, 0, 0)),
-            pl.BlockSpec((TILE_R, l), lambda i: (i, 0)),
-            pl.BlockSpec((TILE_R, l), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_R, lc, k), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((TILE_R, lc), lambda i, j: (i, j)),
+            pl.BlockSpec((TILE_R, lc), lambda i, j: (i, j)),
         ],
         out_specs=[
-            pl.BlockSpec((TILE_R, k, k), lambda i: (i, 0, 0)),
-            pl.BlockSpec((TILE_R, k), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_R, k, k), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((TILE_R, k), lambda i, j: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rp, k, k), jnp.float32),
             jax.ShapeDtypeStruct((rp, k), jnp.float32),
         ],
         interpret=interpret,
-    )(f.astype(jnp.float32), w.astype(jnp.float32), c.astype(jnp.float32))
+    )(f, w.astype(jnp.float32), c.astype(jnp.float32))
     return a[:r], b[:r]
 
 
@@ -136,19 +188,45 @@ GJ_LANES = 128  # systems per program — one per vector lane
 
 
 def gj_fits_vmem(k: int) -> bool:
-    """Whether the GJ kernel's per-program working set fits VMEM.
+    """Whether the lanes-solve kernels' per-program working set fits VMEM.
 
-    The kernel holds the [k, k, 128] input block plus a same-shape VMEM
-    scratch (f32): 2·k²·128·4 bytes, with double-buffering on the input.
-    Budget ~12 MB of the ~16 MB/core keeps headroom; above it (k ≳ 96)
-    callers must take the Cholesky path — the kernel would fail to
-    compile where XLA's solver still works (round-2 advisor finding).
+    The kernel holds the natural [128, k, k] input block (double-buffered)
+    plus the lane-major [k, k, 128] scratch, all f32.  Budget ~12 MB of
+    the ~16 MB/core keeps headroom; above it (k ≳ 72) callers must take
+    the Cholesky path — the kernel would fail to compile where XLA's
+    solver still works (round-2 advisor finding).
     """
-    return 3 * k * k * GJ_LANES * 4 <= 12 * 1024 * 1024
+    return 5 * k * k * GJ_LANES * 4 <= 12 * 1024 * 1024
 
 
-def _gj_kernel(a_ref, b_ref, x_ref, m_ref):
-    """Solve A x = b for GJ_LANES pre-regularized SPD systems per program.
+def _load_lane_major(a_ref, b_ref, reg_ref, m_ref, v_ref):
+    """In-kernel batch→lane staging: natural [T,K,K]/[T,K] blocks →
+    lane-major ``m [K,K,T]`` / ``v [K,1,T]`` VMEM scratch, ridge added.
+
+    Doing the transpose HERE (a 2-D [T, K·K] ↔ [K·K, T] VMEM shuffle)
+    instead of host-side removes the [B,K,K] relayout copy + transpose XLA
+    emitted between the gram dots and the solve — measured ~20 ms of the
+    round-3 iteration at the ML-25M shape.
+    """
+    t, k, _ = a_ref.shape
+    regv = reg_ref[:].reshape(1, 1, t)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (1, k, 1), 1)
+    # K two-dimensional [T,K]→[K,T] transposes: Mosaic has no 3-D
+    # minor-collapsing reshape, but 2-D f32 transposes lower cleanly.
+    for r in range(k):
+        sl = a_ref[:, pl.ds(r, 1), :].reshape(t, k)
+        tr = jnp.transpose(sl, (1, 0)).reshape(1, k, t)
+        m_ref[pl.ds(r, 1)] = tr + (ci == r).astype(jnp.float32) * regv
+    v_ref[:] = jnp.transpose(b_ref[:], (1, 0)).reshape(k, 1, t)
+
+
+def _store_lane_major(x_ref, v_ref):
+    t, k = x_ref.shape
+    x_ref[:] = jnp.transpose(v_ref[:].reshape(k, t), (1, 0))
+
+
+def _gj_kernel(a_ref, b_ref, reg_ref, x_ref, m_ref, v_ref):
+    """Solve (A + diag(reg)) x = b for GJ_LANES systems per program.
 
     Layout is the whole trick: systems live on the LANE dimension —
     ``m [K, K, 128]`` holds matrix element (r, c) of system t at
@@ -163,55 +241,57 @@ def _gj_kernel(a_ref, b_ref, x_ref, m_ref):
     The "set row j to the normalized row" step is folded into the update:
     ``m - (col - e_j) ⊗ row_n`` eliminates every other row and lands row j
     on ``row_n`` in one expression (col's pivot entry becomes p-1).
+
+    Because every system is confined to its own lane, a boundary block
+    whose tail lanes are Pallas OOB padding solves garbage there without
+    touching real lanes — the padded x rows are simply never written back.
     """
-    k = a_ref.shape[0]
+    k = a_ref.shape[1]
+    _load_lane_major(a_ref, b_ref, reg_ref, m_ref, v_ref)
     sub_iota = jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1), 0)
-    m_ref[:] = a_ref[:]
-    x_ref[:] = b_ref[:]
 
     def step(j, _):
         row = m_ref[pl.ds(j, 1), :, :]                # [1, K, T] row j
         col = m_ref[:, pl.ds(j, 1), :]                # [K, 1, T] col j
         inv = 1.0 / m_ref[pl.ds(j, 1), pl.ds(j, 1), :]  # [1, 1, T] pivot
         row_n = row * inv                             # [1, K, T]
-        bj = x_ref[pl.ds(j, 1), :, :] * inv           # [1, 1, T]
+        bj = v_ref[pl.ds(j, 1), :, :] * inv           # [1, 1, T]
         ej = (sub_iota == j).astype(jnp.float32)      # [K, 1, 1]
         col_m = col - ej                              # pivot row → p-1
         m_ref[:] = m_ref[:] - col_m * row_n           # lane-parallel FMA
-        x_ref[:] = x_ref[:] - col_m * bj
+        v_ref[:] = v_ref[:] - col_m * bj
         return 0
 
     jax.lax.fori_loop(0, k, step, 0, unroll=False)
+    _store_lane_major(x_ref, v_ref)
 
 
 def _ridge_solve_lanes(kernel, a, b, reg, interpret: bool):
-    """Shared host-side scaffolding for the systems-on-lanes solvers:
-    ridge pre-add, GJ_LANES padding (identity-filled, solutions
-    discarded), batch→lane transposes, pallas_call, inverse transpose."""
+    """Shared scaffolding for the systems-on-lanes solvers.
+
+    Inputs stay in their NATURAL layouts ([B,K,K], [B,K], [B]) — the
+    lane-major staging happens inside the kernel, so no relayout copies
+    are emitted between the gram build, this solve, and the factor
+    scatter.  A non-multiple-of-128 batch rides Pallas's auto-padded
+    boundary block (lane-isolated systems make the padding harmless).
+    """
     bt, k = b.shape
-    a = (a + reg[:, None, None] * jnp.eye(k, dtype=jnp.float32)).astype(jnp.float32)
-    pad = (-bt) % GJ_LANES
-    if pad:
-        a = jnp.pad(a, ((0, pad), (0, 0), (0, 0)))
-        a = a.at[bt:].set(jnp.eye(k, dtype=jnp.float32))
-        b = jnp.pad(b, ((0, pad), (0, 0)))
-    bp = bt + pad
-    # Batch → lanes: [B,K,K] → [K,K,B], [B,K] → [K,1,B].
-    at = jnp.transpose(a, (1, 2, 0))
-    btr = jnp.transpose(b.astype(jnp.float32), (1, 0))[:, None, :]
     x = pl.pallas_call(
         kernel,
-        grid=(bp // GJ_LANES,),
+        grid=(-(-bt // GJ_LANES),),
         in_specs=[
-            pl.BlockSpec((k, k, GJ_LANES), lambda i: (0, 0, i)),
-            pl.BlockSpec((k, 1, GJ_LANES), lambda i: (0, 0, i)),
+            pl.BlockSpec((GJ_LANES, k, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((GJ_LANES, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, GJ_LANES), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((k, 1, GJ_LANES), lambda i: (0, 0, i)),
-        out_shape=jax.ShapeDtypeStruct((k, 1, bp), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((k, k, GJ_LANES), jnp.float32)],
+        out_specs=pl.BlockSpec((GJ_LANES, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bt, k), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((k, k, GJ_LANES), jnp.float32),
+                        pltpu.VMEM((k, 1, GJ_LANES), jnp.float32)],
         interpret=interpret,
-    )(at, btr)
-    return jnp.transpose(x[:, 0, :], (1, 0))[:bt]
+    )(a.astype(jnp.float32), b.astype(jnp.float32),
+      reg.astype(jnp.float32).reshape(1, bt))
+    return x
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -220,7 +300,7 @@ def ridge_solve_gj_pallas(a, b, reg, *, interpret: bool = False):
     return _ridge_solve_lanes(_gj_kernel, a, b, reg, interpret)
 
 
-def _lu_kernel(a_ref, b_ref, x_ref, m_ref):
+def _lu_kernel(a_ref, b_ref, reg_ref, x_ref, m_ref, v_ref):
     """Cholesky-free LDU solve for GJ_LANES SPD systems per program.
 
     Same systems-on-lanes layout as the GJ kernel, but the elimination
@@ -230,9 +310,8 @@ def _lu_kernel(a_ref, b_ref, x_ref, m_ref):
     runs K cheap [1, ·, T] steps on the upper-triangular remainder.
     No pivoting: A + diag(reg) is SPD (ALS-WR reg ≥ λ).
     """
-    k = a_ref.shape[0]
-    m_ref[:] = a_ref[:]
-    x_ref[:] = b_ref[:]
+    k = a_ref.shape[1]
+    _load_lane_major(a_ref, b_ref, reg_ref, m_ref, v_ref)
     blk = 8  # sublane granule — update starts stay aligned
 
     # Forward elimination, block-quantized shrinkage.
@@ -243,23 +322,24 @@ def _lu_kernel(a_ref, b_ref, x_ref, m_ref):
             continue  # last row: nothing below to eliminate
         inv = 1.0 / m_ref[pl.ds(j, 1), pl.ds(j, 1), :]    # [1,1,T]
         row_n = m_ref[pl.ds(j, 1), :, :] * inv            # [1,K,T]
-        bj = x_ref[pl.ds(j, 1), :, :] * inv               # [1,1,T]
+        bj = v_ref[pl.ds(j, 1), :, :] * inv               # [1,1,T]
         col = m_ref[pl.ds(start, rows), pl.ds(j, 1), :]   # [rows,1,T]
         # Rows < j+1 inside the aligned block must not change: zero their
         # multiplier (cheap [rows,1,1] iota mask, not a [K,K] mask).
         sub_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, 1, 1), 0)
         col = jnp.where(sub_iota + start > j, col, 0.0)
         m_ref[pl.ds(start, rows)] = m_ref[pl.ds(start, rows)] - col * row_n
-        x_ref[pl.ds(start, rows)] = x_ref[pl.ds(start, rows)] - col * bj
+        v_ref[pl.ds(start, rows)] = v_ref[pl.ds(start, rows)] - col * bj
 
-    # Back-substitution on the upper triangle (x_ref holds modified b).
+    # Back-substitution on the upper triangle (v_ref holds modified b).
     for j in range(k - 1, -1, -1):
         inv = 1.0 / m_ref[pl.ds(j, 1), pl.ds(j, 1), :]
-        xj = x_ref[pl.ds(j, 1), :, :] * inv               # [1,1,T]
-        x_ref[pl.ds(j, 1)] = xj
+        xj = v_ref[pl.ds(j, 1), :, :] * inv               # [1,1,T]
+        v_ref[pl.ds(j, 1)] = xj
         if j:
             col = m_ref[pl.ds(0, j), pl.ds(j, 1), :]      # [j,1,T]
-            x_ref[pl.ds(0, j)] = x_ref[pl.ds(0, j)] - col * xj
+            v_ref[pl.ds(0, j)] = v_ref[pl.ds(0, j)] - col * xj
+    _store_lane_major(x_ref, v_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

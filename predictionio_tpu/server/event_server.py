@@ -101,6 +101,11 @@ class EventServer:
         self.host = host
         self.port = port
         self.stats = _Stats()
+        # Positive accessKey cache (5 s TTL): the ingest hot path otherwise
+        # pays a metadata SELECT per request.  Key revocation propagates
+        # within the TTL; auth FAILURES are never cached.
+        self._auth_cache: Dict[str, Tuple[float, Any]] = {}
+        self._auth_ttl = 5.0
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -120,9 +125,14 @@ class EventServer:
                     key = None
         if not key:
             return None, 401
+        now = time.monotonic()
+        hit = self._auth_cache.get(key)
+        if hit is not None and now - hit[0] < self._auth_ttl:
+            return hit[1], None
         row = self.storage.get_access_keys().get(key)
         if row is None:
             return None, 401
+        self._auth_cache[key] = (now, row)
         return row, None
 
     def _resolve_channel(self, app_id: int, params) -> Tuple[Optional[int], Optional[str]]:
@@ -179,7 +189,11 @@ class EventServer:
             if len(arr) > MAX_BATCH_SIZE:
                 return 400, {"message":
                              f"Batch size exceeds the limit of {MAX_BATCH_SIZE}."}
-            out = []
+            # Validate per item, then ONE group-committed insert for the
+            # valid ones — per-item inserts each paid a transaction commit
+            # (48 µs apiece measured), capping batch ingest at ~10k ev/s.
+            out: List[Optional[Dict[str, Any]]] = []
+            valid: List[Tuple[int, Any]] = []
             for item in arr:
                 try:
                     ev = event_from_json(item)
@@ -187,10 +201,19 @@ class EventServer:
                         out.append({"status": 403,
                                     "message": f"Event {ev.event!r} not allowed."})
                         continue
-                    event_id = events.insert(ev, key_row.app_id, channel_id)
-                    out.append({"status": 201, "eventId": event_id})
+                    valid.append((len(out), ev))
+                    out.append(None)  # filled after the batched insert
                 except (EventValidationError, StorageError) as e:
                     out.append({"status": 400, "message": str(e)})
+            if valid:
+                try:
+                    ids = events.insert_batch([ev for _, ev in valid],
+                                              key_row.app_id, channel_id)
+                    for (slot, _), eid in zip(valid, ids):
+                        out[slot] = {"status": 201, "eventId": eid}
+                except StorageError as e:
+                    for slot, _ in valid:
+                        out[slot] = {"status": 400, "message": str(e)}
             return 200, out
 
         if path == "/events.json" and method == "GET":
@@ -267,6 +290,10 @@ class EventServer:
     def _make_handler(server_self):
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + delayed-ACK between our multi-write responses and a
+            # keep-alive client stalls every request ~40 ms (measured:
+            # 44 ms/req with a persistent connection, 0.9 ms without).
+            disable_nagle_algorithm = True
 
             def _dispatch(self, method: str):
                 t0 = time.perf_counter()

@@ -120,6 +120,47 @@ def test_mesh_equivalence():
                                np.asarray(m2.user_factors), rtol=1e-3, atol=1e-3)
 
 
+def test_blocked_factor_sharded_equivalence():
+    """Blueprint blocked ALS (SURVEY §2.4 row 2): row-sharding the
+    PERSISTENT factor matrices over the data axis changes placement, not
+    math — and the state really stays sharded across sweeps."""
+    from jax.sharding import NamedSharding
+
+    users, items, ratings = _toy(seed=5)
+    base = dict(rank=4, iterations=3, reg=0.05, seed=9, bucket_bounds=(8,))
+    mesh = make_mesh({"data": 8})
+    # Mesh-divisible extents: the returned factors keep their sharding.
+    m1 = train_als(users, items, ratings, 32, 24, ALSConfig(**base))
+    m2 = train_als(users, items, ratings, 32, 24,
+                   ALSConfig(**base, factor_sharding="sharded"), mesh=mesh)
+    sh = m2.user_factors.sharding
+    assert isinstance(sh, NamedSharding) and sh.spec[0] == "data", \
+        "blocked mode must keep the factor state row-sharded"
+    np.testing.assert_allclose(np.asarray(m1.user_factors),
+                               np.asarray(m2.user_factors),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(m1.item_factors),
+                               np.asarray(m2.item_factors),
+                               rtol=1e-3, atol=1e-3)
+    # Non-divisible extents ride the padding path; same math.
+    m3 = train_als(users, items, ratings, 30, 20, ALSConfig(**base))
+    m4 = train_als(users, items, ratings, 30, 20,
+                   ALSConfig(**base, factor_sharding="sharded"), mesh=mesh)
+    assert m4.user_factors.shape == (30, 4)
+    np.testing.assert_allclose(np.asarray(m3.user_factors),
+                               np.asarray(m4.user_factors),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_factor_sharding_auto_threshold():
+    from predictionio_tpu.models.als import _shard_factors
+
+    small = ALSConfig(rank=4)
+    assert not _shard_factors(small, 30, 20)
+    big = ALSConfig(rank=128, factor_shard_threshold=1 << 20)
+    assert _shard_factors(big, 100_000, 50_000)
+
+
 def test_recommend_excludes_seen():
     users, items, ratings = _toy(density=0.4)
     cfg = ALSConfig(rank=4, iterations=5)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Compile the fused ALS training loop at the bench shape WITHOUT data and
+dump the compiled-HLO op mix — the fast loop for layout experiments.
+
+The round-3 profile says 80 ms/iter (32%) of the ML-25M iteration is XLA
+layout copies + scatter overhead.  This tool reconstructs the exact bucket
+shapes host-side (same plan_buckets logic the device prep uses), lowers
+``_train_loop`` from ShapeDtypeStructs, compiles it on the real TPU
+backend, and aggregates the op kinds/shapes so a layout change's effect on
+the emitted copies is visible in seconds instead of a full benchmark run.
+
+Usage: PIO_BENCH_SCALE=1.0 python tools/als_hlo.py [out.hlo]
+"""
+import os
+import re
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.ops.device_prep import plan_buckets
+
+SCALE = float(os.environ.get("PIO_BENCH_SCALE", "1.0"))
+N_USERS = max(64, int(162_541 * SCALE))
+N_ITEMS = max(64, int(59_047 * SCALE))
+N_RATINGS = max(4096, int(25_000_000 * SCALE))
+RANK = 64
+
+
+def synth(seed=0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, N_USERS, N_RATINGS)
+    items = (rng.zipf(1.25, size=N_RATINGS) % N_ITEMS).astype(np.int64)
+    return users, items
+
+
+def host_plan(ids, n_rows, cfg):
+    """Host-numpy reproduction of _prepare_als_inputs_device's planning."""
+    split_above = cfg.split_above or 1 << 20
+    counts = np.bincount(ids, minlength=n_rows).astype(np.int64)
+    clipped = np.minimum(counts, split_above)
+    hist = np.bincount(clipped, minlength=split_above + 1)
+    over = counts > split_above
+    n_over = int(over.sum())
+    n_part = int(np.where(over, (counts + split_above - 1) // split_above,
+                          0).sum())
+    over_deg = counts[np.nonzero(over)[0]] if n_over else None
+    return plan_buckets(hist, n_over, n_part, n_rows,
+                        split_above=split_above,
+                        bucket_bounds=cfg.bucket_bounds,
+                        max_block_floats=cfg.max_block_floats,
+                        rank=cfg.rank, over_degrees=over_deg)
+
+
+def plan_shapes(plan):
+    """ShapeDtypeStruct bucket tuples exactly as build_buckets emits them."""
+    f32, i32, b_ = jnp.float32, jnp.int32, jnp.bool_
+    out, kinds = [], []
+    for i, (b, rp) in enumerate(zip(plan.bounds, plan.rows_padded)):
+        chunks = plan.plain_chunks[i] if plan.plain_chunks else ((0, rp),)
+        for cs, cn in chunks:
+            S = jax.ShapeDtypeStruct
+            out.append((S((cn, b), i32), S((cn, b), f32), S((cn, b), b_),
+                        S((cn,), i32)))
+            kinds.append("plain")
+    if plan.split_len is not None:
+        sl = plan.split_len
+        S = jax.ShapeDtypeStruct
+        chunks = plan.split_chunks or (
+            (0, plan.split_segs, 0, plan.split_rows),)
+        for e0, e1, r0, r1 in chunks:
+            pad = plan.pad_rows_to
+            rr = r1 - r0 + ((-(r1 - r0)) % pad)
+            ss = e1 - e0 + ((-(e1 - e0)) % pad)
+            out.append((S((rr, sl), i32), S((rr, sl), f32), S((rr, sl), b_),
+                        S((rr,), i32), S((ss,), i32)))
+            kinds.append("merged")
+    return tuple(out), tuple(kinds)
+
+
+def main():
+    users, items = synth()
+    cfg = als_lib.ALSConfig(rank=RANK, iterations=2, reg=0.01, seed=1)
+    up, uk = plan_shapes(host_plan(users, N_USERS, cfg))
+    ip, ik = plan_shapes(host_plan(items, N_ITEMS, cfg))
+    S = jax.ShapeDtypeStruct
+    uf = S((N_USERS, RANK), jnp.float32)
+    itf = S((N_ITEMS, RANK), jnp.float32)
+    kinds = (uk, ik)
+    use_pallas = os.environ.get("PIO_ALS_PALLAS", "1") == "1"
+    pallas_flags = (tuple(use_pallas for _ in uk),
+                    tuple(use_pallas for _ in ik))
+    gdt = als_lib._resolve_gram_dtype(cfg.gram_dtype)
+    solver = os.environ.get("PIO_ALS_SOLVER", "lu")
+
+    print(f"shape {N_USERS}x{N_ITEMS}x{N_RATINGS} rank{RANK} "
+          f"buckets u={len(uk)} i={len(ik)} gdt={gdt} solver={solver}",
+          file=sys.stderr)
+    lowered = jax.jit(als_lib._train_loop, static_argnames=(
+        "kinds", "pallas_flags", "implicit", "gram_dtype", "solver")).lower(
+        uf, itf, up, ip, S((), jnp.float32), S((), jnp.float32),
+        S((), jnp.int32), kinds=kinds, pallas_flags=pallas_flags,
+        implicit=False, gram_dtype=gdt, solver=solver)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    if len(sys.argv) > 1:
+        open(sys.argv[1], "w").write(txt)
+        print(f"wrote {sys.argv[1]} ({len(txt)/1e6:.1f} MB)", file=sys.stderr)
+
+    # Aggregate ops by kind; big tensors only.
+    agg = defaultdict(lambda: [0, 0.0])  # kind -> [count, total_MB]
+    for m in re.finditer(
+            r"^\s*(?:ROOT )?%?[\w.\-]+ = ([a-z0-9]+)\[([\d,]*)\][^=]*"
+            r"(copy|transpose|scatter|gather|fusion|convert|"
+            r"dynamic-update-slice|dynamic-slice|custom-call|reduce|dot)\(",
+            txt, re.M):
+        dt, shp, kind = m.groups()
+        n = 1
+        for d in (shp.split(",") if shp else []):
+            if d:
+                n *= int(d)
+        bytes_per = {"f32": 4, "s32": 4, "u32": 4, "bf16": 2, "pred": 1,
+                     "f64": 8, "u8": 1, "s8": 1}.get(dt, 4)
+        mb = n * bytes_per / 1e6
+        agg[kind][0] += 1
+        if mb > 1.0:
+            agg[kind][1] += mb
+    print(f"{'op kind':25s} {'count':>7s} {'MB(>1MB ops)':>14s}")
+    for kind, (cnt, mb) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        print(f"{kind:25s} {cnt:7d} {mb:14.1f}")
+
+    # The biggest copies, with shapes.
+    copies = []
+    for m in re.finditer(
+            r"^\s*%?[\w.\-]+ = ([a-z0-9]+)\[([\d,]*)\][^\n]*?(copy)\(",
+            txt, re.M):
+        dt, shp, _ = m.groups()
+        n = 1
+        for d in (shp.split(",") if shp else []):
+            if d:
+                n *= int(d)
+        bytes_per = {"f32": 4, "s32": 4, "bf16": 2, "pred": 1}.get(dt, 4)
+        copies.append((n * bytes_per / 1e6, f"{dt}[{shp}]", m.group(0)[:160]))
+    copies.sort(reverse=True)
+    print("\ntop copies:")
+    for mb, shp, line in copies[:12]:
+        print(f"  {mb:9.1f} MB {shp}")
+        print(f"    {line.strip()[:150]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Round-4 ALS measurement: einsum path vs natural-layout Pallas path.
+
+Preps ML-25M-shape inputs once on the device, then slope-times the fused
+training loop under both gram/solve configurations and phase-profiles the
+winner.  One process so the (uncacheable on this backend) prep compile is
+paid once.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.models.als import (
+    ALSConfig, prepare_als_inputs, train_als_prepared,
+)
+
+SCALE = float(os.environ.get("PIO_BENCH_SCALE", "1.0"))
+N_USERS = max(64, int(162_541 * SCALE))
+N_ITEMS = max(64, int(59_047 * SCALE))
+N_RATINGS = max(4096, int(25_000_000 * SCALE))
+RANK = 64
+I1, I2 = 2, 12
+
+
+def synth(seed=0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, N_USERS, N_RATINGS)
+    items = (rng.zipf(1.25, size=N_RATINGS) % N_ITEMS).astype(np.int64)
+    ratings = (rng.integers(1, 11, N_RATINGS) * 0.5).astype(np.float32)
+    return users, items, ratings
+
+
+def main():
+    users, items, ratings = synth()
+    ratings = ratings + np.float32((time.time_ns() % 997) * 1e-6)
+    cfg0 = ALSConfig(rank=RANK, iterations=I1, reg=0.01, seed=1)
+    t0 = time.perf_counter()
+    du = jnp.asarray(users.astype(np.int32))
+    di = jnp.asarray(items.astype(np.int32))
+    dr = jnp.asarray(ratings)
+    float(jnp.sum(dr))
+    print(f"h2d {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg0)
+    float(jnp.sum(inputs.uf0))
+    print(f"prep {time.perf_counter()-t0:.1f}s", flush=True)
+
+    def run(iters, **kw):
+        cfg = ALSConfig(rank=RANK, iterations=iters, reg=0.01, seed=1, **kw)
+        t0 = time.perf_counter()
+        m = train_als_prepared(inputs, cfg)
+        float(jnp.sum(m.user_factors))
+        return time.perf_counter() - t0, m
+
+    results = {}
+    variants = [
+        ("pallas_lu", dict(use_pallas=True, solver="lu")),
+        ("pallas_gj", dict(use_pallas=True, solver="gj")),
+        ("einsum_lu", dict(use_pallas=False, solver="lu")),
+    ]
+    ref_model = None
+    for name, kw in variants:
+        t0 = time.perf_counter()
+        _, m = run(I1, **kw)
+        compile_s = time.perf_counter() - t0
+        t1, _ = run(I1, **kw)
+        t2, m = run(I2, **kw)
+        per_iter = (t2 - t1) / (I2 - I1) * 1e3
+        results[name] = {"per_iter_ms": round(per_iter, 1),
+                         "compile_s": round(compile_s, 1)}
+        print(f"{name}: {per_iter:.1f} ms/iter (compile {compile_s:.0f}s)",
+              flush=True)
+        if ref_model is None:
+            ref_model = m
+        else:
+            d = float(jnp.max(jnp.abs(m.user_factors - ref_model.user_factors)))
+            s = float(jnp.max(jnp.abs(ref_model.user_factors)))
+            results[name]["max_dev_vs_first"] = round(d / s, 5)
+            print(f"  rel dev vs pallas_lu: {d/s:.2e}", flush=True)
+
+    # Phase profile of the winner (same machinery as bench.py).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    phases = bench.phase_profile(inputs)
+    results["phase_ms_pallas"] = phases
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

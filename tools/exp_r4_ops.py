@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Top-op timing breakdown of the round-4 ALS iteration (xplane dump)."""
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.models.als import (
+    ALSConfig, prepare_als_inputs, train_als_prepared,
+)
+
+SCALE = float(os.environ.get("PIO_BENCH_SCALE", "1.0"))
+N_USERS = max(64, int(162_541 * SCALE))
+N_ITEMS = max(64, int(59_047 * SCALE))
+N_RATINGS = max(4096, int(25_000_000 * SCALE))
+RANK = 64
+ITERS = 4
+
+
+def main():
+    rng = np.random.default_rng(0)
+    users = rng.integers(0, N_USERS, N_RATINGS)
+    items = (rng.zipf(1.25, size=N_RATINGS) % N_ITEMS).astype(np.int64)
+    ratings = (rng.integers(1, 11, N_RATINGS) * 0.5).astype(np.float32)
+    cfg = ALSConfig(rank=RANK, iterations=2, reg=0.01, seed=1)
+    t0 = time.perf_counter()
+    du = jnp.asarray(users.astype(np.int32))
+    di = jnp.asarray(items.astype(np.int32))
+    dr = jnp.asarray(ratings + np.float32((time.time_ns() % 997) * 1e-6))
+    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg)
+    float(jnp.sum(inputs.uf0))
+    print(f"prep+h2d {time.perf_counter()-t0:.0f}s", flush=True)
+    m = train_als_prepared(inputs, cfg)  # compile
+    float(jnp.sum(m.user_factors))
+
+    import glob
+    import tempfile
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    with tempfile.TemporaryDirectory(prefix="pio_trace_") as td:
+        with jax.profiler.trace(td):
+            c2 = ALSConfig(rank=RANK, iterations=ITERS, reg=0.01, seed=1)
+            m = train_als_prepared(inputs, c2)
+            float(jnp.sum(m.user_factors))
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(glob.glob(
+            f"{td}/**/*.xplane.pb", recursive=True)[0], "rb").read())
+        tpu = [p for p in xs.planes if p.name.startswith("/device:TPU")][0]
+        evm = {k: v.name for k, v in tpu.event_metadata.items()}
+        agg = defaultdict(float)
+        for line in tpu.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = evm.get(ev.metadata_id, "")
+                if name.startswith(("%while", "jit_")):
+                    continue
+                agg[name] += ev.duration_ps / 1e9
+        total = sum(agg.values())
+        print(f"total device ms over {ITERS} iters: {total:.0f} "
+              f"({total/ITERS:.1f}/iter)")
+        for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:35]:
+            print(f"  {ms/ITERS:8.2f} ms/iter  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

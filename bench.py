@@ -234,7 +234,9 @@ def phase_profile(inputs, iters=4):
                     continue
                 if "ridge_solve" in name:
                     phases["solve"] += ms
-                elif re.match(r"%fusion", name):
+                elif "fused_gram" in name or re.match(r"%fusion", name):
+                    # The round-4 Pallas gram custom-call belongs with the
+                    # gather fusions: together they are the A/b build.
                     phases["gather_gram"] += ms
                 elif re.match(r"%copy", name):
                     phases["copy"] += ms
@@ -254,7 +256,7 @@ def serving_bench():
         out = {}
         srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
         srv.start()
-        out["python"] = bench_serving._drive(srv.port, n_users, 16, 1500)
+        out["python"] = bench_serving._drive(srv.port, n_users, 32, 2000)
         srv.stop()
         try:
             from predictionio_tpu.native.frontend import NativeFrontend
@@ -262,7 +264,7 @@ def serving_bench():
             fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
                                 max_batch=64, max_wait_us=1000)
             fe.start()
-            out["native"] = bench_serving._drive(fe.port, n_users, 16, 1500)
+            out["native"] = bench_serving._drive(fe.port, n_users, 32, 2000)
             fe.stop()
         except RuntimeError as e:
             out["native"] = {"error": str(e)}

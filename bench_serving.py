@@ -59,23 +59,40 @@ def _setup():
 
 
 def _drive(port: int, n_users: int, clients: int, requests: int):
-    url = f"http://127.0.0.1:{port}/queries.json"
+    """Closed-loop saturation throughput PLUS unloaded latency.
+
+    Workers keep persistent connections (an SDK-shaped client): on this
+    one-core bench host the old fresh-connection urllib client spent more
+    CPU than the server, and its p50 was pure queueing delay (round-3
+    verdict item 3).  ``p50_unloaded_ms`` is measured at concurrency 1 —
+    BASELINE.md metric 3's actual meaning.
+    """
+    import http.client
+    import threading
+
     rng = np.random.default_rng(1)
     payloads = [json.dumps({"user": f"u{rng.integers(0, n_users)}",
                             "num": 10}).encode() for _ in range(requests)]
-    latencies = []
+    local = threading.local()
 
     def one(body):
         t0 = time.perf_counter()
         for attempt in range(3):
+            conn = getattr(local, "conn", None)
+            if conn is None:
+                conn = local.conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=30)
             try:
-                req = urllib.request.Request(
-                    url, data=body,
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=30) as r:
-                    r.read()
+                conn.request("POST", "/queries.json", body,
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                if r.status != 200:
+                    raise RuntimeError(f"serving returned {r.status}")
                 break
-            except (ConnectionError, OSError):
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                local.conn = None
                 if attempt == 2:
                     raise
                 time.sleep(0.05 * (attempt + 1))
@@ -85,6 +102,7 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
     # batch size the continuous batcher can form gets compiled pre-timing.
     for body in payloads[:5]:
         one(body)
+    unloaded = np.array([one(b) for b in payloads[:300]])
     with concurrent.futures.ThreadPoolExecutor(clients) as ex:
         list(ex.map(one, payloads[: 8 * clients]))
     t0 = time.perf_counter()
@@ -94,6 +112,7 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
     lat = np.array(latencies)
     return {
         "throughput_rps": round(requests / wall, 1),
+        "p50_unloaded_ms": round(float(np.percentile(unloaded, 50)), 2),
         "p50_ms": round(float(np.percentile(lat, 50)), 2),
         "p95_ms": round(float(np.percentile(lat, 95)), 2),
         "p99_ms": round(float(np.percentile(lat, 99)), 2),

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
+import threading
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -81,10 +83,15 @@ class ALSConfig:
     solver: str = "auto"
     use_pallas: Optional[bool] = None  # None = auto (on for single-chip TPU)
     # HBM guard: cap the gathered [rows, L, K] block at this many floats;
-    # jumbo buckets are solved in row chunks (256 MB f32 at the default —
-    # several chunks are live at once inside the fused iteration loop, and
-    # 1 GB blocks OOMed the 16 GB chip at ML-25M scale).
-    max_block_floats: int = 1 << 26
+    # jumbo buckets are solved in row chunks.  Round 4 doubled the default
+    # (1<<26 → 1<<27): the Pallas gram path gathers in bf16 with NO
+    # relayout copy alongside, so the same byte budget admits twice the
+    # rows — and halving the chunk count cuts both the cold compile time
+    # (program size ∝ chunk count; no persistent compile cache on this
+    # backend) and per-chunk dispatch overhead.  1 GB f32-equivalent
+    # blocks OOMed the 16 GB chip at ML-25M scale; 512 MB-equivalent
+    # (256 MB bf16 gathered) leaves headroom.
+    max_block_floats: int = 1 << 27
     # "auto" = bucket on-device (ops/device_prep.py) when running on TPU
     # with no mesh and no max_degree truncation; True/False force.  The
     # host-numpy bucketing + padded-block upload was 84% of end-to-end
@@ -430,6 +437,13 @@ class ALSInputs:
     Separating prep from the iteration loop lets callers (serving reloads,
     the benchmark's slope timing, incremental retrains) re-run the fused
     training program without re-bucketing or re-uploading.
+
+    Two layouts: the host/mesh path stores PRE-CHUNKED tuples
+    (``chunk_specs is None``); the device-prep path stores BUCKET-level
+    arrays plus static ``chunk_specs`` and the training loop slices the
+    HBM chunks in-graph — emitting per-chunk outputs from the build
+    program cost ~1.1 s of (serialized, uncacheable) compile per chunk on
+    this backend, ~45 s of the round-3 cold start.
     """
 
     uf0: jax.Array
@@ -438,6 +452,9 @@ class ALSInputs:
     item_buckets: List[Tuple]
     n_users: int
     n_items: int
+    # Per side: tuple over buckets of ("plain", ((cs, cn), ...)) or
+    # ("merged", pad_to, ((e0, e1, r0, r1), ...)); None = pre-chunked.
+    chunk_specs: Optional[Tuple[Tuple, Tuple]] = None
 
 
 def prepare_als_inputs(
@@ -503,6 +520,9 @@ def prepare_als_inputs(
                      n_items=n_items)
 
 
+_BUILD_CACHE: dict = {}  # (BucketPlan, nnz) -> AOT-compiled build program
+
+
 def _prepare_als_inputs_device(
     user_ids, item_ids, ratings, n_users: int, n_items: int,
     config: ALSConfig,
@@ -527,7 +547,7 @@ def _prepare_als_inputs_device(
 
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
 
-    def one_side(rows, cols, n_rows):
+    def side_plan(rows, n_rows):
         counts = jnp.zeros(n_rows, jnp.int32).at[rows].add(1)
         hist, n_over, n_part = degree_histogram(counts, split_above)
         over_deg = None
@@ -536,22 +556,58 @@ def _prepare_als_inputs_device(
             # needs them to place split-chunk boundaries (tiny D2H).
             ids = jnp.nonzero(counts > split_above, size=n_over)[0]
             over_deg = np.asarray(counts[ids])
-        plan = plan_buckets(hist, n_over, n_part, n_rows,
+        return plan_buckets(hist, n_over, n_part, n_rows,
                             split_above=split_above,
                             bucket_bounds=config.bucket_bounds,
                             max_block_floats=config.max_block_floats,
                             rank=k, over_degrees=over_deg)
-        plain, split = build_buckets(rows, cols, vals, plan)
-        out = [("plain", *chunk) for chunk in plain]
-        if split is not None:
-            out.extend(("merged", *chunk) for chunk in split)
-        return out
 
-    user_buckets = one_side(rows_u, rows_i, n_users)
-    item_buckets = one_side(rows_i, rows_u, n_items)
-    return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
-                     item_buckets=item_buckets, n_users=n_users,
-                     n_items=n_items)
+    plan_u = side_plan(rows_u, n_users)
+    plan_i = side_plan(rows_i, n_items)
+
+    # The build program emits BUCKET-level arrays (chunk slicing happens
+    # in-graph inside the training loop — see _expand_chunks); its compile
+    # is the cold-start wall on this backend (serialized, uncacheable), so
+    # every op it doesn't contain is ~1 s saved.  AOT executables bypass
+    # the jit cache, so memoize per (plan, nnz) — warm re-preps (retrains,
+    # the bench's second pass) skip the compile.  The two sides' compiles
+    # are issued concurrently; a backend whose compile service can
+    # parallelize overlaps them (this tunnel serializes them — measured).
+    import concurrent.futures
+
+    build_u = dataclasses.replace(plan_u, plain_chunks=(), split_chunks=())
+    build_i = dataclasses.replace(plan_i, plain_chunks=(), split_chunks=())
+    jitted = jax.jit(build_buckets.__wrapped__, static_argnames=("plan",))
+    nnz = rows_u.shape[0]
+    co_u = _BUILD_CACHE.get((build_u, nnz))
+    co_i = _BUILD_CACHE.get((build_i, nnz))
+    if co_u is None or co_i is None:
+        lo_u = jitted.lower(rows_u, rows_i, vals, plan=build_u)
+        lo_i = jitted.lower(rows_i, rows_u, vals, plan=build_i)
+        with concurrent.futures.ThreadPoolExecutor(2) as ex:
+            co_u, co_i = list(ex.map(lambda lo: lo.compile(), (lo_u, lo_i)))
+        _BUILD_CACHE[(build_u, nnz)] = co_u
+        _BUILD_CACHE[(build_i, nnz)] = co_i
+
+    def one_side(compiled, rows, cols, plan):
+        plain, split = compiled(rows, cols, vals)
+        out = [("plain", *b) for b in plain]
+        specs = [("plain", ch) for ch in plan.plain_chunks]
+        if split is not None:
+            out.extend(("merged", *b) for b in split)
+            specs.append(("merged", plan.pad_rows_to, plan.split_chunks))
+        return out, tuple(specs)
+
+    user_buckets, spec_u = one_side(co_u, rows_u, rows_i, plan_u)
+    item_buckets, spec_i = one_side(co_i, rows_i, rows_u, plan_i)
+    inputs = ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
+                       item_buckets=item_buckets, n_users=n_users,
+                       n_items=n_items, chunk_specs=(spec_u, spec_i))
+    # Overlap the (~70 s cold) fused-loop compile with whatever the caller
+    # does next — prep read-backs, checkpoint setup, eval prep.
+    threading.Thread(target=_warm_train_loop, args=(inputs, config),
+                     daemon=True).start()
+    return inputs
 
 
 def train_als(
@@ -598,48 +654,16 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     item_buckets = inputs.item_buckets
     reg = jnp.float32(config.reg)
     alpha = jnp.float32(config.alpha)
-    use_pallas = config.use_pallas
-    if use_pallas is None:
-        # Default ON for TPU (round 4).  Round-3 measured the einsum path
-        # at 250 ms/iter (ML-25M shape): gather+gram 138, solve 32.5,
-        # layout copies 47.7, scatter/misc 33.  The copies were XLA
-        # relayouting every gathered [R,L,K] block from the gather's
-        # K-minor layout to the L-minor layout the gram dots want, and
-        # A relayouts feeding the lanes-solve.  The round-4 kernels
-        # consume/emit natural layouts end to end (gather → fused gram →
-        # in-kernel-transposing solve → scatter), which removes those
-        # copies; the earlier "Pallas measured identical" result came
-        # from the old kernel's materialized f32 cast of the gathered
-        # block, which cost what the copy cost.  (A scalar-loop in-kernel
-        # gather measured 0.30 G rows/s — worse than XLA's own engine;
-        # don't go back there.)
-        use_pallas = pallas_supported()
-    def _bucket_pallas(idx) -> bool:
-        # Jumbo buckets (max-degree outliers) exceed the per-program VMEM
-        # tile budget — those take the einsum path.
-        return use_pallas and fits_vmem(idx.shape[1], k)
-
-    solver = config.solver
-    if solver == "auto":
-        # The elimination kernels target the VPU; on CPU meshes the XLA
-        # Cholesky is fine and interpret-mode Pallas would be slow.
-        # High ranks overflow the kernel's VMEM working set — Cholesky.
-        solver = "lu" if pallas_supported() and gj_fits_vmem(k) \
-            else "cholesky"
-
+    statics = _resolve_loop_statics(config, user_buckets, item_buckets,
+                                    inputs.chunk_specs)
     # The WHOLE alternation loop is one jitted program: a fori_loop over
     # iterations with every bucket step unrolled in the body.  One dispatch
     # per training run instead of O(iterations x buckets) — launch/host
     # round-trip latency, not FLOPs, dominated the per-step formulation
     # (measured: solver/precision/padding changes moved ML-1M train time
     # <10%; fusing the loop is what actually buys throughput).
-    kinds = (tuple(b[0] for b in user_buckets),
-             tuple(b[0] for b in item_buckets))
-    pallas_flags = (tuple(_bucket_pallas(b[1]) for b in user_buckets),
-                    tuple(_bucket_pallas(b[1]) for b in item_buckets))
     ubk = tuple(tuple(b[1:]) for b in user_buckets)
     ibk = tuple(tuple(b[1:]) for b in item_buckets)
-    gdt = _resolve_gram_dtype(config.gram_dtype)
 
     # Blocked (factor-sharded) mode: re-impose the row-sharding on the
     # carry each sweep so GSPMD keeps the persistent state sharded instead
@@ -649,9 +673,7 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     def sweeps(uf, itf, n):
         return _train_loop(
             uf, itf, ubk, ibk, reg, alpha, jnp.int32(n),
-            kinds=kinds, pallas_flags=pallas_flags,
-            implicit=config.implicit, gram_dtype=gdt, solver=solver,
-            factor_shardings=factor_shardings)
+            factor_shardings=factor_shardings, **statics)
 
     if checkpoint_dir and save_every > 0:
         from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
@@ -683,16 +705,152 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
                     implicit=config.implicit)
 
 
+def _expand_chunks(buckets, specs):
+    """Static in-graph slicing of bucket-level arrays into HBM chunks.
+
+    Runs inside :func:`_train_loop` (slices/pads of device arrays are
+    free-ish graph ops); mirrors exactly the chunk layout the build
+    program used to emit per-chunk (ops/device_prep.py build_buckets'
+    chunk tail) before round 4 moved it here to shrink the uncacheable
+    prep compile.
+    """
+    if specs is None:
+        return buckets  # pre-chunked (host/mesh path)
+    out = []
+    for arrs, spec in zip(buckets, specs):
+        if spec[0] == "plain":
+            idx, vals, msk, rid = arrs
+            chunks = spec[1]
+            if len(chunks) <= 1:
+                out.append(arrs)
+                continue
+            for cs, cn in chunks:
+                out.append((idx[cs:cs + cn], vals[cs:cs + cn],
+                            msk[cs:cs + cn], rid[cs:cs + cn]))
+        else:
+            _, pad_to, chunks = spec
+            if not chunks:
+                out.append(arrs)
+                continue
+            idx, vals, msk, seg, ent = arrs
+            for e0, e1, r0, r1 in chunks:
+                n_chunk = e1 - e0
+                seg_pad = (-n_chunk) % pad_to
+                row_pad = (-(r1 - r0)) % pad_to
+                oob = n_chunk + seg_pad  # padding rows → dropped slot
+                seg_c = seg[r0:r1]
+                seg_c = jnp.where((seg_c >= e0) & (seg_c < e1),
+                                  seg_c - e0, oob)
+
+                def padrows(a):
+                    return jnp.pad(a, ((0, row_pad),) + ((0, 0),)
+                                   * (a.ndim - 1))
+
+                out.append((padrows(idx[r0:r1]), padrows(vals[r0:r1]),
+                            padrows(msk[r0:r1]),
+                            jnp.pad(seg_c, (0, row_pad), constant_values=oob),
+                            jnp.pad(ent[e0:e1], (0, seg_pad),
+                                    constant_values=-1)))
+    return tuple(out)
+
+
+def _resolve_loop_statics(config: ALSConfig, user_buckets, item_buckets,
+                          chunk_specs=None):
+    """The static arguments of :func:`_train_loop` for this config/layout.
+
+    Shared by the training entry point and the compile pre-warm so both
+    hit the same jit-cache entry.  With ``chunk_specs``, kinds/flags are
+    emitted per EXPANDED chunk in :func:`_expand_chunks` order.
+    """
+    k = config.rank
+    use_pallas = config.use_pallas
+    if use_pallas is None:
+        # Default ON for TPU (round 4).  Round-3 measured the einsum path
+        # at 250 ms/iter (ML-25M shape): gather+gram 138, solve 32.5,
+        # layout copies 47.7, scatter/misc 33.  The copies were XLA
+        # relayouting every gathered [R,L,K] block from the gather's
+        # K-minor layout to the L-minor layout the gram dots want, and
+        # A relayouts feeding the lanes-solve.  The round-4 kernels
+        # consume/emit natural layouts end to end (gather → fused gram →
+        # in-kernel-transposing solve → scatter), which removes those
+        # copies (measured 250.4 → 187.8 ms/iter, copy phase 47.7 → 0.5).
+        # (A scalar-loop in-kernel gather measured 0.30 G rows/s — worse
+        # than XLA's own engine; don't go back there.)
+        use_pallas = pallas_supported()
+
+    def _bucket_pallas(idx) -> bool:
+        return use_pallas and fits_vmem(idx.shape[1], k)
+
+    solver = config.solver
+    if solver == "auto":
+        # The elimination kernels target the VPU; on CPU meshes the XLA
+        # Cholesky is fine and interpret-mode Pallas would be slow.
+        # High ranks overflow the kernel's VMEM working set — Cholesky.
+        solver = "lu" if pallas_supported() and gj_fits_vmem(k) \
+            else "cholesky"
+
+    def side_meta(buckets, specs):
+        kinds, flags = [], []
+        for i, b in enumerate(buckets):
+            n = 1
+            if specs is not None:
+                chunks = specs[i][-1]
+                n = max(len(chunks), 1)
+            kinds.extend([b[0]] * n)
+            flags.extend([_bucket_pallas(b[1])] * n)
+        return tuple(kinds), tuple(flags)
+
+    uspec = chunk_specs[0] if chunk_specs else None
+    ispec = chunk_specs[1] if chunk_specs else None
+    uk, upf = side_meta(user_buckets, uspec)
+    ik, ipf = side_meta(item_buckets, ispec)
+    return dict(
+        kinds=(uk, ik),
+        pallas_flags=(upf, ipf),
+        implicit=config.implicit,
+        gram_dtype=_resolve_gram_dtype(config.gram_dtype),
+        solver=solver,
+        chunk_specs=chunk_specs,
+    )
+
+
+def _warm_train_loop(inputs: "ALSInputs", config: ALSConfig) -> None:
+    """Fire-and-forget compile of the fused loop for these inputs.
+
+    A ZERO-iteration call populates the jit cache (the loop bound is a
+    traced scalar, so iterations=0 shares the compiled program with the
+    real run) without executing any sweep.  Called from device prep on a
+    background thread so the ~70 s loop compile overlaps prep execution —
+    a cold first `pio train` pays max(prep, loop) instead of their sum.
+    """
+    try:
+        statics = _resolve_loop_statics(config, inputs.user_buckets,
+                                        inputs.item_buckets,
+                                        inputs.chunk_specs)
+        _train_loop(inputs.uf0, inputs.itf0,
+                    tuple(tuple(b[1:]) for b in inputs.user_buckets),
+                    tuple(tuple(b[1:]) for b in inputs.item_buckets),
+                    jnp.float32(config.reg), jnp.float32(config.alpha),
+                    jnp.int32(0), factor_shardings=(None, None), **statics)
+    except Exception:  # pre-warm must never sink a train
+        logging.getLogger(__name__).debug("loop pre-warm failed",
+                                          exc_info=True)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "kinds", "pallas_flags", "implicit", "gram_dtype", "solver",
-    "factor_shardings"))
+    "factor_shardings", "chunk_specs"))
 def _train_loop(uf0, itf0, user_buckets, item_buckets, reg, alpha, iterations,
                 *, kinds, pallas_flags, implicit, gram_dtype, solver,
-                factor_shardings=(None, None)):
+                factor_shardings=(None, None), chunk_specs=None):
     # ``iterations`` is a traced scalar on purpose: the fori_loop bound being
     # dynamic means warmup (1 iter) and the real run (N iters) share one
     # compiled program.
     gdt = jnp.dtype(gram_dtype)
+    user_buckets = _expand_chunks(
+        user_buckets, chunk_specs[0] if chunk_specs else None)
+    item_buckets = _expand_chunks(
+        item_buckets, chunk_specs[1] if chunk_specs else None)
 
     def side(buckets, side_kinds, side_pallas, dst, src):
         # yty hoisted: identical for every bucket of the side.

@@ -67,15 +67,23 @@ class NativeFrontend:
 
     def _on_batch(self, batch_handle, n: int) -> None:
         try:
-            raw: List[Optional[dict]] = []
+            datas: List[bytes] = []
             for i in range(n):
                 ln = ctypes.c_int(0)
-                data = self._lib.pio_batch_request(batch_handle, i,
-                                                   ctypes.byref(ln))
-                try:
-                    raw.append(json.loads(data or b"null"))
-                except json.JSONDecodeError:
-                    raw.append(None)
+                datas.append(self._lib.pio_batch_request(
+                    batch_handle, i, ctypes.byref(ln)) or b"null")
+            raw: List[Optional[dict]]
+            try:
+                # One C-level parse for the whole batch instead of n
+                # json.loads calls under the GIL.
+                raw = json.loads(b"[" + b",".join(datas) + b"]")
+            except json.JSONDecodeError:
+                raw = []
+                for data in datas:  # isolate the malformed item(s)
+                    try:
+                        raw.append(json.loads(data))
+                    except json.JSONDecodeError:
+                        raw.append(None)
             # Malformed JSON answered inline; valid ones go to the handler.
             valid_idx = [i for i, r in enumerate(raw) if r is not None]
             results: List[Any] = [None] * n

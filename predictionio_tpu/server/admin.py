@@ -101,6 +101,10 @@ class AdminServer:
     def _make_handler(server_self):
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + delayed-ACK between multi-write responses and a
+            # keep-alive client stalls every request ~40 ms (measured on
+            # the event server; same handler shape here).
+            disable_nagle_algorithm = True
 
             def _dispatch(self, method):
                 parsed = urlparse(self.path)

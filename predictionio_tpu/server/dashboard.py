@@ -112,6 +112,10 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
     def _make_handler(server_self):
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + delayed-ACK between multi-write responses and a
+            # keep-alive client stalls every request ~40 ms (measured on
+            # the event server; same handler shape here).
+            disable_nagle_algorithm = True
 
             def do_GET(self):  # noqa: N802
                 status, ctype, payload = server_self.handle(

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Where does the ALS cold-start compile time go? (round-4 item 4)
+
+Times, separately: the device-prep build program per side, and the fused
+training loop — all via AOT lower().compile() from ShapeDtypeStructs (no
+data, no execution), which is exactly the cold cost a first `pio train`
+pays on this backend (no persistent compile cache).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.ops import device_prep
+from tools.als_hlo import N_ITEMS, N_RATINGS, N_USERS, RANK, host_plan, \
+    plan_shapes, synth
+
+
+def main():
+    users, items = synth()
+    cfg = als_lib.ALSConfig(rank=RANK, iterations=2, reg=0.01, seed=1,
+                            max_block_floats=int(os.environ.get(
+                                "PIO_ALS_MBF", str(1 << 27))))
+    S = jax.ShapeDtypeStruct
+
+    for side, ids, n in (("user", users, N_USERS), ("item", items, N_ITEMS)):
+        plan = host_plan(ids, n, cfg)
+        t0 = time.perf_counter()
+        jax.jit(device_prep.build_buckets, static_argnames=("plan",)).lower(
+            S((N_RATINGS,), jnp.int32), S((N_RATINGS,), jnp.int32),
+            S((N_RATINGS,), jnp.float32), plan=plan).compile()
+        print(f"prep[{side}] compile: {time.perf_counter()-t0:.0f}s "
+              f"(buckets={len(plan.bounds)}, "
+              f"chunks={sum(len(c) for c in plan.plain_chunks)}"
+              f"+{max(len(plan.split_chunks), 1 if plan.split_len else 0)})",
+              flush=True)
+
+    up, uk = plan_shapes(host_plan(users, N_USERS, cfg))
+    ip, ik = plan_shapes(host_plan(items, N_ITEMS, cfg))
+    t0 = time.perf_counter()
+    jax.jit(als_lib._train_loop, static_argnames=(
+        "kinds", "pallas_flags", "implicit", "gram_dtype", "solver",
+        "factor_shardings")).lower(
+        S((N_USERS, RANK), jnp.float32), S((N_ITEMS, RANK), jnp.float32),
+        up, ip, S((), jnp.float32), S((), jnp.float32), S((), jnp.int32),
+        kinds=(uk, ik),
+        pallas_flags=(tuple(True for _ in uk), tuple(True for _ in ik)),
+        implicit=False, gram_dtype="bfloat16", solver="lu").compile()
+    print(f"train_loop compile: {time.perf_counter()-t0:.0f}s "
+          f"(bucket steps: {len(uk)}+{len(ik)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,8 +76,9 @@ def synth_ml25m(seed=0):
 def useful_flops_per_iter(inputs):
     """Padded-nnz gram/rhs + Cholesky-equivalent solve FLOPs, both sides.
 
-    Counted off the ACTUAL device buckets (incl. mesh row padding and HBM
-    chunk padding) so the reported MFU matches the dispatched program.
+    Counted off the device bucket arrays (incl. mesh row padding; the
+    in-graph HBM chunk expansion adds a little more row padding that is
+    NOT credited here, so MFU is if anything slightly under-reported).
     """
     total = 0.0
     for buckets in (inputs.user_buckets, inputs.item_buckets):

@@ -90,7 +90,9 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
                 if r.status != 200:
                     raise RuntimeError(f"serving returned {r.status}")
                 break
-            except (OSError, http.client.HTTPException):
+            except (OSError, http.client.HTTPException, RuntimeError):
+                # RuntimeError = non-200 status: transient 5xx under
+                # saturation retries like any connection fault.
                 conn.close()
                 local.conn = None
                 if attempt == 2:

@@ -23,6 +23,7 @@ Hu-Koren-Volinsky: confidence c = 1 + α·r, preference p = 1(r>0), with the
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import logging
@@ -520,7 +521,24 @@ def prepare_als_inputs(
                      n_items=n_items)
 
 
-_BUILD_CACHE: dict = {}  # (BucketPlan, nnz) -> AOT-compiled build program
+# (BucketPlan, nnz) -> AOT-compiled build program.  LRU-bounded: a
+# long-lived retrain loop sees a new nnz every cycle and must not leak one
+# executable per retrain.
+_BUILD_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_BUILD_CACHE_MAX = 6
+
+
+def _build_cache_get(key):
+    co = _BUILD_CACHE.get(key)
+    if co is not None:
+        _BUILD_CACHE.move_to_end(key)
+    return co
+
+
+def _build_cache_put(key, co):
+    _BUILD_CACHE[key] = co
+    while len(_BUILD_CACHE) > _BUILD_CACHE_MAX:
+        _BUILD_CACHE.popitem(last=False)
 
 
 def _prepare_als_inputs_device(
@@ -579,15 +597,23 @@ def _prepare_als_inputs_device(
     build_i = dataclasses.replace(plan_i, plain_chunks=(), split_chunks=())
     jitted = jax.jit(build_buckets.__wrapped__, static_argnames=("plan",))
     nnz = rows_u.shape[0]
-    co_u = _BUILD_CACHE.get((build_u, nnz))
-    co_i = _BUILD_CACHE.get((build_i, nnz))
-    if co_u is None or co_i is None:
-        lo_u = jitted.lower(rows_u, rows_i, vals, plan=build_u)
-        lo_i = jitted.lower(rows_i, rows_u, vals, plan=build_i)
-        with concurrent.futures.ThreadPoolExecutor(2) as ex:
-            co_u, co_i = list(ex.map(lambda lo: lo.compile(), (lo_u, lo_i)))
-        _BUILD_CACHE[(build_u, nnz)] = co_u
-        _BUILD_CACHE[(build_i, nnz)] = co_i
+    co_u = _build_cache_get((build_u, nnz))
+    co_i = _build_cache_get((build_i, nnz))
+    todo = []
+    if co_u is None:
+        todo.append(("u", jitted.lower(rows_u, rows_i, vals, plan=build_u)))
+    if co_i is None:
+        todo.append(("i", jitted.lower(rows_i, rows_u, vals, plan=build_i)))
+    if todo:
+        with concurrent.futures.ThreadPoolExecutor(max(len(todo), 1)) as ex:
+            done = dict(zip((t[0] for t in todo),
+                            ex.map(lambda t: t[1].compile(), todo)))
+        if "u" in done:
+            co_u = done["u"]
+            _build_cache_put((build_u, nnz), co_u)
+        if "i" in done:
+            co_i = done["i"]
+            _build_cache_put((build_i, nnz), co_i)
 
     def one_side(compiled, rows, cols, plan):
         plain, split = compiled(rows, cols, vals)

@@ -72,12 +72,17 @@ class NativeFrontend:
                 ln = ctypes.c_int(0)
                 datas.append(self._lib.pio_batch_request(
                     batch_handle, i, ctypes.byref(ln)) or b"null")
-            raw: List[Optional[dict]]
+            raw: List[Optional[dict]] = []
             try:
                 # One C-level parse for the whole batch instead of n
                 # json.loads calls under the GIL.
                 raw = json.loads(b"[" + b",".join(datas) + b"]")
             except json.JSONDecodeError:
+                raw = []
+            if len(raw) != n:
+                # Parse failed — or a crafted body like '1,2' smuggled
+                # EXTRA array elements through the join, which would
+                # misalign every response in the batch.
                 raw = []
                 for data in datas:  # isolate the malformed item(s)
                     try:

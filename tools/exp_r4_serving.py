@@ -1,65 +1,17 @@
 #!/usr/bin/env python
-"""Serving-path experiments: client cost, unloaded latency, GIL funnel."""
+"""Serving-path experiment: drive both frontends with the bench client.
+
+Thin wrapper over bench_serving._drive (which reports saturation
+throughput AND concurrency-1 unloaded latency) at a couple of client
+counts — used to pick the bench's saturation point.
+"""
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench_serving
-
-
-def drive_keepalive(port, n_users, clients, requests, unloaded=False):
-    import concurrent.futures
-    import http.client
-    import threading
-
-    rng = np.random.default_rng(1)
-    payloads = [json.dumps({"user": f"u{rng.integers(0, n_users)}",
-                            "num": 10}).encode() for _ in range(requests)]
-    local = threading.local()
-
-    def one(body):
-        t0 = time.perf_counter()
-        for attempt in (0, 1, 2):
-            conn = getattr(local, "conn", None)
-            if conn is None:
-                conn = local.conn = http.client.HTTPConnection(
-                    "127.0.0.1", port, timeout=30)
-            try:
-                conn.request("POST", "/queries.json", body,
-                             {"Content-Type": "application/json"})
-                r = conn.getresponse()
-                r.read()
-                if r.status != 200:
-                    raise RuntimeError(f"status {r.status}")
-                break
-            except (OSError, http.client.HTTPException):
-                conn.close()
-                local.conn = None
-                if attempt == 2:
-                    raise
-        return (time.perf_counter() - t0) * 1e3
-
-    for body in payloads[:5]:
-        one(body)
-    if unloaded:
-        lat = np.array([one(b) for b in payloads[:400]])
-        return {"p50_unloaded_ms": round(float(np.percentile(lat, 50)), 2),
-                "p99_unloaded_ms": round(float(np.percentile(lat, 99)), 2)}
-    import concurrent.futures
-    with concurrent.futures.ThreadPoolExecutor(clients) as ex:
-        list(ex.map(one, payloads[: 8 * clients]))
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(clients) as ex:
-        lat = np.array(list(ex.map(one, payloads)))
-    wall = time.perf_counter() - t0
-    return {"throughput_rps": round(requests / wall, 1),
-            "p50_ms": round(float(np.percentile(lat, 50)), 2),
-            "p99_ms": round(float(np.percentile(lat, 99)), 2)}
 
 
 def main():
@@ -68,21 +20,18 @@ def main():
 
     srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
     srv.start()
-    print("python unloaded:", drive_keepalive(srv.port, n_users, 1, 500,
-                                              unloaded=True), flush=True)
-    print("python ka 16c:", drive_keepalive(srv.port, n_users, 16, 3000),
+    print("python 16c:",
+          json.dumps(bench_serving._drive(srv.port, n_users, 16, 2000)),
           flush=True)
     from predictionio_tpu.native.frontend import NativeFrontend
 
     fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
                         max_batch=64, max_wait_us=1000)
     fe.start()
-    print("native unloaded:", drive_keepalive(fe.port, n_users, 1, 500,
-                                              unloaded=True), flush=True)
-    print("native ka 16c:", drive_keepalive(fe.port, n_users, 16, 3000),
-          flush=True)
-    print("native ka 32c:", drive_keepalive(fe.port, n_users, 32, 3000),
-          flush=True)
+    for clients in (16, 32):
+        print(f"native {clients}c:",
+              json.dumps(bench_serving._drive(fe.port, n_users, clients,
+                                              3000)), flush=True)
     fe.stop()
     srv.stop()
 

@@ -95,6 +95,27 @@ def main():
     prep_s = time.perf_counter() - t0
     print(f"prep_s={prep_s:.2f}", file=sys.stderr)
 
+    # Bucket-level inputs (round 4): expand the HBM chunks exactly as the
+    # training loop does, so per-bucket gathers below stay within the
+    # chunk budget instead of materializing a whole jumbo bucket.
+    from predictionio_tpu.models.als import _expand_chunks
+    import jax as _jax
+    if inputs.chunk_specs is not None:
+        expand = _jax.jit(_expand_chunks, static_argnames=("specs",))
+        u_kinds = []
+        for b, spec in zip(inputs.user_buckets, inputs.chunk_specs[0]):
+            u_kinds.extend([b[0]] * max(len(spec[-1]), 1))
+        i_kinds = []
+        for b, spec in zip(inputs.item_buckets, inputs.chunk_specs[1]):
+            i_kinds.extend([b[0]] * max(len(spec[-1]), 1))
+        ub = expand(tuple(tuple(b[1:]) for b in inputs.user_buckets),
+                    specs=inputs.chunk_specs[0])
+        ib = expand(tuple(tuple(b[1:]) for b in inputs.item_buckets),
+                    specs=inputs.chunk_specs[1])
+        inputs.user_buckets = [(k, *a) for k, a in zip(u_kinds, ub)]
+        inputs.item_buckets = [(k, *a) for k, a in zip(i_kinds, ib)]
+        inputs.chunk_specs = None
+
     report = {"shape": f"{N_USERS}x{N_ITEMS}x{N_RATINGS} rank{RANK}",
               "prep_s": round(prep_s, 2), "sides": {}}
     reg = jnp.float32(0.01)

@@ -7,35 +7,42 @@
 // from worker threads so the Python/JAX process never blocks on batch
 // assembly: the feeder fills pinned buffers while the device runs step N.
 //
-// File format "PIOF1" (little-endian), version 2:
+// File format "PIOF1" (little-endian), version 3:
 //   0:  char[5] magic "PIOF1"
 //   5:  u8      pad
-//   6:  u16     version (=2)
+//   6:  u16     version (=3)
 //   8:  u64     n_rows
 //   16: u32     n_extra   (extra f32 feature columns, e.g. DLRM dense)
-//   20: u32     pad
-//   24: u32[n]  user ids
-//   ...:u32[n]  item ids
+//   20: u32     n_cat     (categorical u32 id columns; v2 wrote 0 here
+//                          and always carried exactly 2 — user/item)
+//   24: u32[n]  categorical column 0 (user ids in the 2-column case)
+//   ...:u32[n]  x (n_cat - 1) further categorical columns
 //   ...:f32[n]  values
 //   ...:<pad to 8-byte boundary>
 //   ...:i64[n]  event_time_us            (8-byte aligned by construction)
 //   ...:f32[n] x n_extra feature columns (column-major: col0 rows, col1...)
 //
-// Version 1 files (no n_extra field, data at offset 16, times potentially
-// only 4-byte aligned when n is odd) are still readable: their times are
-// copied via memcpy, never dereferenced as int64* (the round-1 layout made
-// misaligned loads UB on strict-alignment targets).
+// Version 2 files read as n_cat == 2.  Version 1 files (no n_extra field,
+// data at offset 16, times potentially only 4-byte aligned when n is odd)
+// are still readable: their times are copied via memcpy, never
+// dereferenced as int64* (the round-1 layout made misaligned loads UB on
+// strict-alignment targets).
 //
 // C API (consumed via ctypes from predictionio_tpu/native/feeder.py):
 //   void*  pio_feeder_open(const char* path, uint64_t seed, int shuffle);
 //   int64  pio_feeder_num_rows(void*);
 //   int32  pio_feeder_n_extra(void*);
+//   int32  pio_feeder_n_cat(void*);
 //   int64  pio_feeder_next_batch(void*, int64 batch, uint32* users,
 //                                uint32* items, float* vals, int64* times,
 //                                float* extras /* [batch, n_extra] row-major,
 //                                                 may be null */);
 //        -> rows written (== batch unless epoch end; 0 = epoch boundary,
-//           next call starts the re-shuffled next epoch)
+//           next call starts the re-shuffled next epoch); requires
+//           n_cat >= 2 (columns 0/1 ride the user/item pointers)
+//   int64  pio_feeder_next_batch_cats(void*, int64 batch,
+//                                uint32* cats /* [batch, n_cat] row-major */,
+//                                float* vals, int64* times, float* extras);
 //   void   pio_feeder_close(void*);
 //
 // Shuffling uses a per-epoch Fisher-Yates permutation under a SplitMix64
@@ -74,8 +81,8 @@ struct Feeder {
   const uint8_t* base = nullptr;
   uint64_t n_rows = 0;
   uint32_t n_extra = 0;
-  const uint32_t* users = nullptr;
-  const uint32_t* items = nullptr;
+  uint32_t n_cat = 0;
+  std::vector<const uint32_t*> cat_cols;
   const float* vals = nullptr;
   const uint8_t* times_raw = nullptr;  // memcpy-read (v1 may be unaligned)
   std::vector<const float*> extras;
@@ -123,7 +130,8 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
   const uint8_t* base = static_cast<const uint8_t*>(m);
   uint16_t version = 0;
   memcpy(&version, base + 6, 2);
-  if (memcmp(base, "PIOF1", 5) != 0 || (version != 1 && version != 2)) {
+  if (memcmp(base, "PIOF1", 5) != 0 ||
+      (version != 1 && version != 2 && version != 3)) {
     munmap(m, st.st_size);
     ::close(fd);
     return nullptr;
@@ -131,13 +139,22 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
   uint64_t n;
   memcpy(&n, base + 8, 8);
   uint32_t n_extra = 0;
+  uint32_t n_cat = 2;
   size_t data_off = 16;
-  if (version == 2) {
+  if (version >= 2) {
     memcpy(&n_extra, base + 16, 4);
     data_off = 24;
   }
-  const size_t vals_end = data_off + n * 12;
-  const size_t times_off = version == 2 ? align8(vals_end) : vals_end;
+  if (version >= 3) {
+    memcpy(&n_cat, base + 20, 4);
+    if (n_cat < 1 || n_cat > 1024) {
+      munmap(m, st.st_size);
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  const size_t vals_end = data_off + n * (size_t(n_cat) * 4 + 4);
+  const size_t times_off = version >= 2 ? align8(vals_end) : vals_end;
   const size_t extras_off = times_off + n * 8;
   const size_t need = extras_off + size_t(n_extra) * n * 4;
   if (static_cast<size_t>(st.st_size) < need) {
@@ -156,9 +173,12 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
   f->base = base;
   f->n_rows = n;
   f->n_extra = n_extra;
-  f->users = reinterpret_cast<const uint32_t*>(base + data_off);
-  f->items = reinterpret_cast<const uint32_t*>(base + data_off + n * 4);
-  f->vals = reinterpret_cast<const float*>(base + data_off + n * 8);
+  f->n_cat = n_cat;
+  for (uint32_t c = 0; c < n_cat; ++c)
+    f->cat_cols.push_back(reinterpret_cast<const uint32_t*>(
+        base + data_off + size_t(c) * n * 4));
+  f->vals = reinterpret_cast<const float*>(base + data_off +
+                                           size_t(n_cat) * n * 4);
   f->times_raw = base + times_off;
   for (uint32_t c = 0; c < n_extra; ++c)
     f->extras.push_back(
@@ -177,11 +197,17 @@ int32_t pio_feeder_n_extra(void* h) {
   return h ? static_cast<int32_t>(static_cast<Feeder*>(h)->n_extra) : -1;
 }
 
-int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
-                              uint32_t* items, float* vals, int64_t* times,
-                              float* extras) {
-  if (!h || batch <= 0) return -1;
-  auto* f = static_cast<Feeder*>(h);
+int32_t pio_feeder_n_cat(void* h) {
+  return h ? static_cast<int32_t>(static_cast<Feeder*>(h)->n_cat) : -1;
+}
+
+namespace {
+
+// Shared batch walk: writes either user/item pointers (legacy 2-column
+// ABI) or the row-major [batch, n_cat] block.
+int64_t next_batch_impl(Feeder* f, int64_t batch, uint32_t* users,
+                        uint32_t* items, uint32_t* cats, float* vals,
+                        int64_t* times, float* extras) {
   std::lock_guard<std::mutex> lk(f->mu);
   if (f->cursor >= f->n_rows) {
     // Epoch boundary: signal once, then start the next epoch.
@@ -189,13 +215,15 @@ int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
     f->reshuffle();
     return 0;
   }
-  const uint64_t take =
-      std::min<uint64_t>(batch, f->n_rows - f->cursor);
+  const uint64_t take = std::min<uint64_t>(batch, f->n_rows - f->cursor);
   const uint32_t ne = f->n_extra;
+  const uint32_t nc = f->n_cat;
   for (uint64_t i = 0; i < take; ++i) {
     const uint64_t r = f->perm[f->cursor + i];
-    users[i] = f->users[r];
-    items[i] = f->items[r];
+    if (users) users[i] = f->cat_cols[0][r];
+    if (items) items[i] = f->cat_cols[1][r];
+    if (cats)
+      for (uint32_t c = 0; c < nc; ++c) cats[i * nc + c] = f->cat_cols[c][r];
     if (vals) vals[i] = f->vals[r];
     if (times)  // memcpy: v1 files may have this column 4-byte aligned only
       memcpy(&times[i], f->times_raw + r * 8, 8);
@@ -205,6 +233,26 @@ int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
   }
   f->cursor += take;
   return static_cast<int64_t>(take);
+}
+
+}  // namespace
+
+int64_t pio_feeder_next_batch(void* h, int64_t batch, uint32_t* users,
+                              uint32_t* items, float* vals, int64_t* times,
+                              float* extras) {
+  if (!h || batch <= 0) return -1;
+  auto* f = static_cast<Feeder*>(h);
+  if (f->n_cat < 2) return -1;  // legacy ABI needs user+item columns
+  return next_batch_impl(f, batch, users, items, nullptr, vals, times,
+                         extras);
+}
+
+int64_t pio_feeder_next_batch_cats(void* h, int64_t batch, uint32_t* cats,
+                                   float* vals, int64_t* times,
+                                   float* extras) {
+  if (!h || batch <= 0 || !cats) return -1;
+  return next_batch_impl(static_cast<Feeder*>(h), batch, nullptr, nullptr,
+                         cats, vals, times, extras);
 }
 
 void pio_feeder_close(void* h) {

@@ -77,7 +77,10 @@ def _init_mlp(key, in_dim: int, dims: Sequence[int]) -> List[Dict]:
     for a, b in zip(all_dims[:-1], all_dims[1:]):
         key, k = jax.random.split(key)
         layers.append({
-            "w": jax.random.normal(k, (a, b), jnp.float32) * (2.0 / a) ** 0.5,
+            # max(a, 1): n_dense == 0 gives the bottom MLP a zero-width
+            # input ([B,0]·[0,H] = 0 + bias) — legal, He scale undefined.
+            "w": jax.random.normal(k, (a, b), jnp.float32)
+            * (2.0 / max(a, 1)) ** 0.5,
             "b": jnp.zeros((b,), jnp.float32),
         })
     return layers
@@ -251,10 +254,10 @@ def train(
     """Minibatch CTR training.
 
     ``data_source`` mirrors two_tower.train: "feeder" streams batches
-    from the native mmap cache (two-field case: cat columns ride the
-    user/item ids, the label rides the value column, dense features ride
-    the v2 extras columns); "numpy" is the host permutation; "auto"
-    picks the feeder when the native library builds and F == 2.
+    from the native mmap cache (v3: any number of categorical columns —
+    real CTR shapes have tens — the label on the value column, dense
+    features on the extras columns); "numpy" is the host permutation;
+    "auto" picks the feeder whenever the native library builds.
     ``checkpoint_dir`` + ``save_every`` give mid-training resume with
     deterministic per-(seed, epoch) batch order in both sources.
     """
@@ -290,36 +293,27 @@ def train(
         from predictionio_tpu.native.feeder import EventFeeder, write_cache
 
         with tempfile.TemporaryDirectory(prefix="pio_dlrm_cache_") as d:
+            # v3 cache: F categorical columns (any CTR shape), the label
+            # on the value column, dense features on the extras columns.
             cache = write_cache(
                 f"{d}/train.piof",
-                cat_global[:, 0].astype(np.uint32),
-                cat_global[:, 1].astype(np.uint32),
-                np.asarray(labels, np.float32),
-                extras=np.asarray(dense, np.float32))
+                cats=cat_global.astype(np.uint32),
+                values=np.asarray(labels, np.float32),
+                extras=(np.asarray(dense, np.float32)
+                        if cfg.n_dense else None))
             with EventFeeder(cache, bs, seed=cfg.seed) as f:
                 for _ in range(cfg.epochs):
-                    for u, i, y, extras in f.epoch():
-                        c = np.stack([u.astype(np.int32),
-                                      i.astype(np.int32)], axis=1)
-                        yield extras, c, y
+                    for batch in f.epoch_cats():
+                        c, y = batch[0], batch[1]
+                        extras = (batch[2] if len(batch) > 2 else
+                                  np.zeros((len(y), 0), np.float32))
+                        yield extras, c.astype(np.int32), y
 
     use_feeder = data_source == "feeder"
-    if use_feeder and cat.shape[1] != 2:
-        raise ValueError(
-            f"data_source='feeder' supports exactly 2 categorical fields "
-            f"(got {cat.shape[1]}); the PIOF1 cache carries them on the "
-            f"user/item id columns. Use data_source='numpy'.")
-    if use_feeder and cfg.n_dense == 0:
-        raise ValueError(
-            "data_source='feeder' requires n_dense > 0 (the feeder's "
-            "extras columns carry the dense features; with none, epoch() "
-            "yields 3-tuples the DLRM loop cannot consume). "
-            "Use data_source='numpy'.")
     if data_source == "auto":
         from predictionio_tpu.native.build import load_library
 
-        use_feeder = (cat.shape[1] == 2 and cfg.n_dense > 0
-                      and load_library("feeder") is not None)
+        use_feeder = load_library("feeder") is not None
     global_step = 0
     for d, c, y in (feeder_epochs() if use_feeder else numpy_epochs()):
         global_step += 1

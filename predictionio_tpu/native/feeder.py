@@ -2,7 +2,9 @@
 
 Write path: :func:`write_cache` converts indexed COO interactions (the
 output of a template DataSource) into the mmap-able PIOF1 columnar cache
-(version 2: optional extra f32 feature columns, e.g. DLRM dense features).
+(version 3: any number of categorical u32 id columns — real CTR shapes —
+plus optional extra f32 feature columns, e.g. DLRM dense features; v1/v2
+files remain readable).
 Read path: :class:`EventFeeder` iterates shuffled batches assembled by the
 native library — numpy buffers are passed straight into C (no copies on
 the C side; the arrays handed back are the reusable buffers).
@@ -24,17 +26,28 @@ __all__ = ["write_cache", "EventFeeder"]
 _MAGIC = b"PIOF1"
 
 
-def write_cache(path, user_ids, item_ids, values=None, times=None,
-                extras=None) -> Path:
-    """Write the PIOF1 v2 binary columnar event cache.
+def write_cache(path, user_ids=None, item_ids=None, values=None, times=None,
+                extras=None, cats=None) -> Path:
+    """Write the PIOF1 v3 binary columnar event cache.
 
+    Either ``user_ids`` + ``item_ids`` (the classic 2-column case) or
+    ``cats`` — an ``[n, F]`` uint32 matrix of F categorical id columns
+    (e.g. a real CTR shape with tens of fields) — must be given.
     ``extras``: optional ``[n, n_extra]`` float32 feature matrix, stored
     column-major per the format (native/feeder.cc header comment).
     """
     path = Path(path)
-    user_ids = np.ascontiguousarray(user_ids, dtype=np.uint32)
-    item_ids = np.ascontiguousarray(item_ids, dtype=np.uint32)
-    n = len(user_ids)
+    if cats is None:
+        if user_ids is None or item_ids is None:
+            raise ValueError("write_cache needs user_ids+item_ids or cats")
+        cats = np.stack([np.asarray(user_ids), np.asarray(item_ids)], axis=1)
+    cats = np.ascontiguousarray(cats, dtype=np.uint32)
+    if cats.ndim == 1:
+        cats = cats[:, None]
+    n, n_cat = cats.shape
+    if not 1 <= n_cat <= 1024:
+        # Mirror the reader's bound — fail at the writer, loudly.
+        raise ValueError(f"n_cat must be in [1, 1024], got {n_cat}")
     if values is None:
         values = np.ones(n, dtype=np.float32)
     if times is None:
@@ -48,14 +61,14 @@ def write_cache(path, user_ids, item_ids, values=None, times=None,
         assert extras.shape[0] == n, "extras rows must match event count"
     n_extra = 0 if extras is None else extras.shape[1]
     with open(path, "wb") as f:
-        f.write(_MAGIC + b"\x00" + struct.pack("<H", 2))
+        f.write(_MAGIC + b"\x00" + struct.pack("<H", 3))
         f.write(struct.pack("<Q", n))
-        f.write(struct.pack("<II", n_extra, 0))
-        f.write(user_ids.tobytes())
-        f.write(item_ids.tobytes())
+        f.write(struct.pack("<II", n_extra, n_cat))
+        for c in range(n_cat):
+            f.write(np.ascontiguousarray(cats[:, c]).tobytes())
         f.write(values.tobytes())
-        pos = 24 + n * 12
-        f.write(b"\x00" * (-pos % 8))  # times are 8-byte aligned in v2
+        pos = 24 + n * (4 * n_cat + 4)
+        f.write(b"\x00" * (-pos % 8))  # times are 8-byte aligned in v2+
         f.write(times.tobytes())
         for c in range(n_extra):
             f.write(np.ascontiguousarray(extras[:, c]).tobytes())
@@ -77,12 +90,20 @@ class EventFeeder:
         lib.pio_feeder_num_rows.argtypes = [ctypes.c_void_p]
         lib.pio_feeder_n_extra.restype = ctypes.c_int32
         lib.pio_feeder_n_extra.argtypes = [ctypes.c_void_p]
+        lib.pio_feeder_n_cat.restype = ctypes.c_int32
+        lib.pio_feeder_n_cat.argtypes = [ctypes.c_void_p]
         lib.pio_feeder_next_batch.restype = ctypes.c_int64
         lib.pio_feeder_next_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_float)]  # extras [batch, n_extra]
+        lib.pio_feeder_next_batch_cats.restype = ctypes.c_int64
+        lib.pio_feeder_next_batch_cats.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),  # cats [batch, n_cat]
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float)]
         lib.pio_feeder_close.argtypes = [ctypes.c_void_p]
         self._lib = lib
         self._h = lib.pio_feeder_open(str(path).encode(), seed, int(shuffle))
@@ -90,8 +111,10 @@ class EventFeeder:
             raise RuntimeError(f"cannot open event cache {path!r}")
         self.batch_size = batch_size
         self.n_extra = int(lib.pio_feeder_n_extra(self._h))
+        self.n_cat = int(lib.pio_feeder_n_cat(self._h))
         self._users = np.empty(batch_size, np.uint32)
         self._items = np.empty(batch_size, np.uint32)
+        self._cats = np.empty((batch_size, self.n_cat), np.uint32)
         self._vals = np.empty(batch_size, np.float32)
         self._times = np.empty(batch_size, np.int64)
         self._extras = (np.empty((batch_size, self.n_extra), np.float32)
@@ -99,6 +122,23 @@ class EventFeeder:
 
     def __len__(self) -> int:
         return int(self._lib.pio_feeder_num_rows(self._h))
+
+    def _extras_ptr(self):
+        return (self._extras.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                if self._extras is not None
+                else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
+
+    def _finish_batch(self, n, lead):
+        """Shared batch tail: error/epoch-boundary handling + copies."""
+        if n < 0:
+            raise RuntimeError("feeder error")
+        if n == 0:
+            return None
+        n = int(n)
+        out = tuple(a[:n].copy() for a in lead) + (self._vals[:n].copy(),)
+        if self._extras is not None:
+            out = out + (self._extras[:n].copy(),)
+        return out
 
     def next_batch(self) -> Optional[Tuple[np.ndarray, ...]]:
         """One batch of (users, items, values[, extras]); None at an epoch
@@ -109,23 +149,30 @@ class EventFeeder:
             self._items.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            self._extras.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
-            if self._extras is not None
-            else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
-        if n < 0:
-            raise RuntimeError("feeder error")
-        if n == 0:
-            return None
-        n = int(n)
-        out = (self._users[:n].copy(), self._items[:n].copy(),
-               self._vals[:n].copy())
-        if self._extras is not None:
-            out = out + (self._extras[:n].copy(),)
-        return out
+            self._extras_ptr())
+        return self._finish_batch(n, (self._users, self._items))
+
+    def next_batch_cats(self) -> Optional[Tuple[np.ndarray, ...]]:
+        """One batch of (cats [n, n_cat], values[, extras]); None at an
+        epoch boundary.  Works for ANY column count (v3 caches)."""
+        n = self._lib.pio_feeder_next_batch_cats(
+            self._h, self.batch_size,
+            self._cats.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._extras_ptr())
+        return self._finish_batch(n, (self._cats,))
 
     def epoch(self) -> Iterator[Tuple[np.ndarray, ...]]:
         while True:
             b = self.next_batch()
+            if b is None:
+                return
+            yield b
+
+    def epoch_cats(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        while True:
+            b = self.next_batch_cats()
             if b is None:
                 return
             yield b

@@ -385,3 +385,80 @@ class TestFeederTrainingIntegration:
         expect = sorted((int(a), int(b), float(c), tuple(np.round(d, 6)))
                         for a, b, c, d in zip(u, i, y, dense))
         assert sorted(rows) == expect
+
+    def test_feeder_v3_cats_roundtrip(self, tmp_path):
+        """F=4 categorical columns (v3 cache): multiset equality of
+        (cat0..3, label, dense) rows across one epoch."""
+        import numpy as np
+        from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+        rng = np.random.default_rng(2)
+        n = 203
+        cats = rng.integers(0, 30, (n, 4)).astype(np.uint32)
+        y = rng.integers(0, 2, n).astype(np.float32)
+        dense = rng.random((n, 2), np.float32)
+        path = write_cache(tmp_path / "v3.piof", cats=cats, values=y,
+                           extras=dense)
+        with EventFeeder(path, batch_size=48, seed=5) as f:
+            assert f.n_cat == 4 and f.n_extra == 2
+            rows = []
+            for bc, by, bx in f.epoch_cats():
+                for k in range(len(by)):
+                    rows.append((tuple(int(v) for v in bc[k]), float(by[k]),
+                                 tuple(np.round(bx[k], 6))))
+        expect = sorted((tuple(int(v) for v in c), float(a),
+                         tuple(np.round(d, 6)))
+                        for c, a, d in zip(cats, y, dense))
+        assert sorted(rows) == expect
+
+    def test_dlrm_feeder_f4_trains_like_numpy(self):
+        """Round-3 weakness 6: the native data path must serve real CTR
+        shapes (F=4 here), not just user/item.  Same dataset through the
+        feeder and the numpy loader → comparable fit on a probe batch."""
+        import numpy as np
+        from predictionio_tpu.models import dlrm as dlrm_lib
+
+        rng = np.random.default_rng(7)
+        n = 600
+        cat = np.stack([rng.integers(0, 12, n), rng.integers(0, 8, n),
+                        rng.integers(0, 6, n), rng.integers(0, 4, n)],
+                       axis=1)
+        # Learnable signal: label depends on field 0.
+        labels = (cat[:, 0] < 6).astype(np.float32)
+        dense = rng.random((n, 3), np.float32)
+        cfg = dlrm_lib.DLRMConfig(vocab_sizes=(12, 8, 6, 4), n_dense=3,
+                                  embed_dim=8, bottom_mlp=(16, 8),
+                                  top_mlp=(16, 8), batch_size=64, epochs=3,
+                                  seed=3)
+        s_np = dlrm_lib.train(dense, cat, labels, cfg, data_source="numpy")
+        s_fd = dlrm_lib.train(dense, cat, labels, cfg, data_source="feeder")
+        p_np = np.asarray(dlrm_lib.predict_proba(s_np, dense, cat, cfg))
+        p_fd = np.asarray(dlrm_lib.predict_proba(s_fd, dense, cat, cfg))
+        pos, neg = labels == 1, labels == 0
+        # Both loaders learned the field-0 signal (shuffle order differs,
+        # exact params need not match).
+        assert p_np[pos].mean() > p_np[neg].mean() + 0.2
+        assert p_fd[pos].mean() > p_fd[neg].mean() + 0.2
+        # And the two fits agree closely on the probe predictions.
+        assert abs(p_np.mean() - p_fd.mean()) < 0.1
+
+    def test_dlrm_feeder_no_dense(self):
+        """n_dense == 0 must work through the feeder (round-3 advisor:
+        the old path crashed unpacking the missing extras column)."""
+        import numpy as np
+        from predictionio_tpu.models import dlrm as dlrm_lib
+
+        rng = np.random.default_rng(8)
+        n = 300
+        cat = np.stack([rng.integers(0, 10, n), rng.integers(0, 5, n)],
+                       axis=1)
+        labels = rng.integers(0, 2, n).astype(np.float32)
+        dense = np.zeros((n, 0), np.float32)
+        cfg = dlrm_lib.DLRMConfig(vocab_sizes=(10, 5), n_dense=0,
+                                  embed_dim=8, bottom_mlp=(16, 8),
+                                  top_mlp=(16,), batch_size=64, epochs=1,
+                                  seed=4)
+        state = dlrm_lib.train(dense, cat, labels, cfg,
+                               data_source="feeder")
+        p = np.asarray(dlrm_lib.predict_proba(state, dense, cat, cfg))
+        assert np.isfinite(p).all() and p.shape == (n,)

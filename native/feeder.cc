@@ -153,7 +153,19 @@ void* pio_feeder_open(const char* path, uint64_t seed, int shuffle) {
       return nullptr;
     }
   }
-  const size_t vals_end = data_off + n * (size_t(n_cat) * 4 + 4);
+  // Bound n (and n_extra) before any offset math: a crafted n_rows near
+  // 2^64 would wrap `n * row_bytes` back under st_size, pass the size
+  // check, and leave the column pointers (and reshuffle's perm.resize)
+  // pointing at garbage.  No real cache can exceed the mapped file size
+  // in rows or hold more extra columns than bytes.
+  const size_t row_bytes = size_t(n_cat) * 4 + 4;
+  if (static_cast<size_t>(st.st_size) < data_off || n_extra > 65536 ||
+      n > (static_cast<size_t>(st.st_size) - data_off) / row_bytes) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  const size_t vals_end = data_off + n * row_bytes;
   const size_t times_off = version >= 2 ? align8(vals_end) : vals_end;
   const size_t extras_off = times_off + n * 8;
   const size_t need = extras_off + size_t(n_extra) * n * 4;

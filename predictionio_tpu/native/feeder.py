@@ -60,6 +60,9 @@ def write_cache(path, user_ids=None, item_ids=None, values=None, times=None,
             extras = extras[:, None]
         assert extras.shape[0] == n, "extras rows must match event count"
     n_extra = 0 if extras is None else extras.shape[1]
+    if n_extra > 65536:
+        # Mirror the reader's bound — fail at the writer, loudly.
+        raise ValueError(f"n_extra must be <= 65536, got {n_extra}")
     with open(path, "wb") as f:
         f.write(_MAGIC + b"\x00" + struct.pack("<H", 3))
         f.write(struct.pack("<Q", n))
@@ -143,6 +146,11 @@ class EventFeeder:
     def next_batch(self) -> Optional[Tuple[np.ndarray, ...]]:
         """One batch of (users, items, values[, extras]); None at an epoch
         boundary."""
+        if self.n_cat < 2:
+            raise RuntimeError(
+                f"cache has {self.n_cat} categorical column(s); the legacy "
+                "(users, items) batch API needs >= 2 — use "
+                "next_batch_cats()/epoch_cats()")
         n = self._lib.pio_feeder_next_batch(
             self._h, self.batch_size,
             self._users.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),

@@ -21,10 +21,13 @@ def _seed(ctx, n_users=24, n_items=12, seed=0):
     storage.get_events().init(app_id)
     rng = np.random.default_rng(seed)
     ev = storage.get_events()
-    # Co-view structure: even users view even items, odd view odd.
+    # Co-view structure: even users view even items, odd view odd.  15
+    # views per user makes the clique unambiguous for ANY correct implicit
+    # ALS (at 5 views the top-4 membership depended on the factor init —
+    # even a numpy reference solver only got 3/4).
     for u in range(n_users):
         pool = [i for i in range(n_items) if i % 2 == u % 2]
-        for i in rng.choice(pool, size=5, replace=True):
+        for i in rng.choice(pool, size=15, replace=True):
             ev.insert(Event(event="view", entity_type="user", entity_id=f"u{u}",
                             target_entity_type="item", target_entity_id=f"i{i}"),
                       app_id)

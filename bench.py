@@ -149,14 +149,27 @@ def train_bench():
     h2d_s = _barrier_all(du, di, dr, t0)
 
     t0 = time.perf_counter()
-    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh)
+    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh,
+                                host_ids=(users, items))
     prep_cold_s = _barrier_inputs(inputs, t0)
-    t0 = time.perf_counter()
-    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh)
-    prep_s = _barrier_inputs(inputs, t0)
 
     def sync(m):
         return float(jnp.sum(m.user_factors))  # host read = real barrier
+
+    # First-ever train: waits on the loop executable the prep pre-warm
+    # overlapped (models/als.py); its remaining compile time is the real
+    # first-train cost a cold `pio train` pays after prep.
+    t0 = time.perf_counter()
+    sync(train_als_prepared(inputs, cfg))
+    first_train_s = time.perf_counter() - t0
+
+    # Warm re-prep AFTER the loop compile resolved = the steady-state
+    # retrain cost (measuring it mid-compile added ~20 s of GIL/tunnel
+    # contention that no steady-state retrain sees).
+    t0 = time.perf_counter()
+    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh,
+                                host_ids=(users, items))
+    prep_s = _barrier_inputs(inputs, t0)
 
     def run(iters):
         cfg = ALSConfig(rank=RANK, iterations=iters, reg=0.01, seed=1)
@@ -165,7 +178,7 @@ def train_bench():
         sync(m)
         return time.perf_counter() - t0, m
 
-    run(I1)  # compile (iteration count is a dynamic loop bound: one compile)
+    run(I1)  # warm dispatch on the re-prepped inputs
     # Slope over device-resident inputs: identical fixed costs, the only
     # difference between the runs is I2 - I1 device iterations.
     t1, _ = run(I1)
@@ -182,6 +195,12 @@ def train_bench():
         "mfu_pct": round(100 * mfu, 2),
         "prep_upload_s": round(prep_s, 2),
         "prep_cold_s": round(prep_cold_s, 2),
+        # prep_cold_s CONTAINS the overlapped loop lowering+compile start
+        # (rounds ≤3 paid the whole ~75 s loop compile invisibly after
+        # prep); first_train_s is the residual wait on that compile, so
+        # cold end-to-end = h2d + prep_cold + first_train.
+        "first_train_s": round(first_train_s, 2),
+        "e2e_cold_s": round(h2d_s + prep_cold_s + first_train_s, 2),
         "h2d_coo_s": round(h2d_s, 2),       # tunnel artifact, see comment
         "e2e_full_train_s": round(h2d_s + prep_s + t2, 2),
         "n_chips": n_chips,
@@ -257,7 +276,7 @@ def serving_bench():
         out = {}
         srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
         srv.start()
-        out["python"] = bench_serving._drive(srv.port, n_users, 32, 2000)
+        out["python"] = bench_serving._drive(srv.port, n_users, 32, 4000)
         srv.stop()
         try:
             from predictionio_tpu.native.frontend import NativeFrontend
@@ -265,7 +284,7 @@ def serving_bench():
             fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
                                 max_batch=64, max_wait_us=1000)
             fe.start()
-            out["native"] = bench_serving._drive(fe.port, n_users, 32, 2000)
+            out["native"] = bench_serving._drive(fe.port, n_users, 32, 4000)
             fe.stop()
         except RuntimeError as e:
             out["native"] = {"error": str(e)}
@@ -274,7 +293,7 @@ def serving_bench():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def ingest_bench(n_single=2000, n_batch=100, batch=50):
+def ingest_bench(n_single=3000, n_batch=400, batch=50):
     """Event-server ingest throughput (round-2 verdict item 8c): real
     HTTP POST /events.json, single and batched, against sqlite-WAL."""
     try:
@@ -361,9 +380,14 @@ def ingest_bench(n_single=2000, n_batch=100, batch=50):
 
 
 def main():
+    # Ingest first: it touches no JAX state, and running it last in a
+    # long-lived full-scale process measured 4.6k batch ev/s against
+    # 18-21k standalone (the ~1 s batch window is poisoned by any
+    # transient stall — GC over the train bench's object graph, WAL
+    # writeback).  Isolation beats narrating the interference.
+    ingest = ingest_bench()
     train = train_bench()
     serving = serving_bench()
-    ingest = ingest_bench()
     value = train.pop("value")
     # Self-baseline: speedup over round 3's measured per-iteration time at
     # the same shape on the same chip (reproducible, unlike the retired

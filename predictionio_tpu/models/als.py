@@ -456,6 +456,9 @@ class ALSInputs:
     # Per side: tuple over buckets of ("plain", ((cs, cn), ...)) or
     # ("merged", pad_to, ((e0, e1, r0, r1), ...)); None = pre-chunked.
     chunk_specs: Optional[Tuple[Tuple, Tuple]] = None
+    # Future resolving to (statics, compiled loop executable) from the
+    # plan-shape pre-warm, or None; see _warm_train_loop_from_plans.
+    loop_warm: Optional[object] = None
 
 
 def prepare_als_inputs(
@@ -466,6 +469,7 @@ def prepare_als_inputs(
     n_items: int,
     config: ALSConfig,
     mesh: Optional[Mesh] = None,
+    host_ids: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> ALSInputs:
     """Bucketing + transfer for :func:`train_als_prepared`.
 
@@ -473,6 +477,9 @@ def prepare_als_inputs(
     (:mod:`predictionio_tpu.ops.device_prep`) on TPU — compact COO up,
     one XLA program builds the padded blocks in HBM — and to the
     host-numpy path elsewhere (CPU tests, meshes, max_degree truncation).
+    ``host_ids``: optional numpy copies of (user_ids, item_ids) for
+    callers that pass pre-uploaded device arrays — lets the bucket plan
+    run on host (one bincount) instead of per-op device round-trips.
     """
     use_dev = config.device_prep
     if use_dev == "auto":
@@ -483,7 +490,8 @@ def prepare_als_inputs(
             use_dev = False
     if use_dev:
         return _prepare_als_inputs_device(user_ids, item_ids, ratings,
-                                          n_users, n_items, config)
+                                          n_users, n_items, config,
+                                          host_ids=host_ids)
     k = config.rank
     pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
@@ -541,31 +549,75 @@ def _build_cache_put(key, co):
         _BUILD_CACHE.popitem(last=False)
 
 
-def _prepare_als_inputs_device(
-    user_ids, item_ids, ratings, n_users: int, n_items: int,
-    config: ALSConfig,
-) -> ALSInputs:
-    """Device-side prep: COO up once, layout transform on the chip."""
+
+# warm_key -> Future[(statics, loop executable) | None]; LRU-bounded like
+# the build cache (retrain loops see a new plan every data refresh).
+_WARM_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def _warm_cache_get(key):
+    fut = _WARM_CACHE.get(key)
+    if fut is not None:
+        _WARM_CACHE.move_to_end(key)
+    return fut
+
+
+def _warm_cache_put(key, fut):
+    _WARM_CACHE[key] = fut
+    while len(_WARM_CACHE) > _BUILD_CACHE_MAX:
+        _WARM_CACHE.popitem(last=False)
+
+
+def _compile_build(lowered):
+    """Compile a prep build program at REDUCED optimization effort.
+
+    The build runs once per dataset (~5 s exec) but its default-effort
+    compile was the cold-start wall (33 + 48 s for the two sides at the
+    ML-25M shape).  ``exec_time_optimization_effort=-1`` compiles the
+    same program in ~21 + 31 s with no measurable exec regression (the
+    program is scatter/gather-bound; there's nothing for the scheduler
+    to win).  The hot training loop stays at DEFAULT effort — low effort
+    there measured 533 vs 184 ms/iter.  Falls back silently where the
+    backend rejects the options (older libtpu, non-TPU platforms).
+    """
+    try:
+        return lowered.compile(compiler_options={
+            "exec_time_optimization_effort": -1.0,
+            "memory_fitting_effort": -1.0,
+        })
+    except Exception:
+        return lowered.compile()
+
+
+def _plan_side(rows: jax.Array, n_rows: int, config: ALSConfig,
+               host_rows: Optional[np.ndarray] = None):
+    """One side's :class:`~ops.device_prep.BucketPlan` from COO ids.
+
+    With ``host_rows`` (the caller's numpy copy of the same ids) the
+    degree statistics run as one ``np.bincount`` — ~0.3 s at 25M rows.
+    The device fallback exists for device-only callers, but each of its
+    small jitted stats ops pays a compile + dispatch round-trip through
+    the remote-TPU tunnel: 37.6 s measured for both sides at the ML-25M
+    shape, which single-handedly blew the cold-prep budget.
+    """
     from predictionio_tpu.ops.device_prep import (
-        build_buckets, degree_histogram, plan_buckets,
+        degree_histogram, plan_buckets,
     )
 
-    k = config.rank
     split_above = config.split_above or 1 << 20
-    rows_u = jnp.asarray(np.asarray(user_ids, dtype=np.int32)
-                         if isinstance(user_ids, np.ndarray) else user_ids,
-                         dtype=jnp.int32)
-    rows_i = jnp.asarray(np.asarray(item_ids, dtype=np.int32)
-                         if isinstance(item_ids, np.ndarray) else item_ids,
-                         dtype=jnp.int32)
-    if ratings is None:
-        vals = jnp.ones(rows_u.shape[0], jnp.float32)
+    if host_rows is not None:
+        # Exact replica of ops.device_prep.degree_histogram: counts over
+        # ALL n_rows entities (zero-degree included), degrees clipped at
+        # the cap into cap+1 bins, over-cap degrees in entity-id order.
+        counts = np.bincount(np.asarray(host_rows), minlength=n_rows)
+        hist = np.bincount(np.minimum(counts, split_above),
+                           minlength=split_above + 1)
+        over = counts > split_above
+        n_over = int(over.sum())
+        n_part = int(((counts[over] + split_above - 1)
+                      // split_above).sum())
+        over_deg = counts[over].astype(np.int64) if n_over else None
     else:
-        vals = jnp.asarray(ratings, dtype=jnp.float32)
-
-    uf, itf = _init_factors(n_users, n_items, k, config.seed)
-
-    def side_plan(rows, n_rows):
         counts = jnp.zeros(n_rows, jnp.int32).at[rows].add(1)
         hist, n_over, n_part = degree_histogram(counts, split_above)
         over_deg = None
@@ -574,49 +626,182 @@ def _prepare_als_inputs_device(
             # needs them to place split-chunk boundaries (tiny D2H).
             ids = jnp.nonzero(counts > split_above, size=n_over)[0]
             over_deg = np.asarray(counts[ids])
-        return plan_buckets(hist, n_over, n_part, n_rows,
-                            split_above=split_above,
-                            bucket_bounds=config.bucket_bounds,
-                            max_block_floats=config.max_block_floats,
-                            rank=k, over_degrees=over_deg)
+    return plan_buckets(hist, n_over, n_part, n_rows,
+                        split_above=split_above,
+                        bucket_bounds=config.bucket_bounds,
+                        max_block_floats=config.max_block_floats,
+                        rank=config.rank, over_degrees=over_deg)
 
-    plan_u = side_plan(rows_u, n_users)
-    plan_i = side_plan(rows_i, n_items)
+
+def _plan_bucket_shapes(plan):
+    """ShapeDtypeStruct bucket tuples exactly as the prep path emits them.
+
+    Mirrors ``_prepare_als_inputs_device.one_side``: plain buckets at
+    BUCKET level (one entry per plan bucket, chunk slicing is in-graph),
+    then the merged split bucket.  Keeping this in lock-step with
+    ``ops.device_prep.build_buckets`` is what lets the loop pre-warm
+    lower an IDENTICAL program from shapes alone (test-asserted:
+    tests/test_device_prep.py::TestPlanShapeLockstep).
+    """
+    S = jax.ShapeDtypeStruct
+    f32, i32, b_ = jnp.float32, jnp.int32, jnp.bool_
+    out = []
+    for b, rp in zip(plan.bounds, plan.rows_padded):
+        out.append(("plain", S((rp, b), i32), S((rp, b), f32),
+                    S((rp, b), b_), S((rp,), i32)))
+    specs = [("plain", ch) for ch in plan.plain_chunks]
+    if plan.split_len is not None:
+        pr, sl, ns = plan.split_rows, plan.split_len, plan.split_segs
+        out.append(("merged", S((pr, sl), i32), S((pr, sl), f32),
+                    S((pr, sl), b_), S((pr,), i32), S((ns,), i32)))
+        specs.append(("merged", plan.pad_rows_to, plan.split_chunks))
+    return out, tuple(specs)
+
+
+def _lower_train_loop_from_plans(config: ALSConfig, plan_u, plan_i,
+                                 n_users: int, n_items: int):
+    """Lower the fused loop from plan shapes only → (statics, Lowered).
+
+    The loop program depends only on the bucket LAYOUT (plan + rank) —
+    verified identical HLO to the live call's lowering, real-array
+    layouts included — so it can be lowered before prep outputs exist.
+    Lowering runs on the CALLING thread (it holds the GIL; doing it on
+    the warm thread stretched a concurrent warm re-prep 5.9 → 18 s).
+    """
+    ub, spec_u = _plan_bucket_shapes(plan_u)
+    ib, spec_i = _plan_bucket_shapes(plan_i)
+    statics = _resolve_loop_statics(config, ub, ib, (spec_u, spec_i))
+    S = jax.ShapeDtypeStruct
+    k = config.rank
+    lowered = _train_loop.lower(
+        S((n_users, k), jnp.float32), S((n_items, k), jnp.float32),
+        tuple(tuple(b[1:]) for b in ub),
+        tuple(tuple(b[1:]) for b in ib),
+        S((), jnp.float32), S((), jnp.float32), S((), jnp.int32),
+        factor_shardings=(None, None), **statics)
+    return statics, lowered
+
+
+def _compile_train_loop(statics, lowered, fut) -> None:
+    """Warm-thread tail: pure compile RPC, no GIL-heavy work.
+
+    Delivers ``(statics, executable)`` (or ``None`` on failure) through
+    ``fut``; :func:`train_als_prepared` CALLS the executable directly —
+    no reliance on any compile-cache or in-flight dedupe behavior of the
+    backend (the shared tunnel's compile service proved too variable to
+    reason about).
+    """
+    try:
+        fut.set_result((statics, lowered.compile()))
+    except Exception:  # pre-warm must never sink a train
+        logging.getLogger(__name__).debug("loop pre-warm compile failed",
+                                          exc_info=True)
+        fut.set_result(None)
+
+
+def _prepare_als_inputs_device(
+    user_ids, item_ids, ratings, n_users: int, n_items: int,
+    config: ALSConfig, host_ids=None,
+) -> ALSInputs:
+    """Device-side prep: COO up once, layout transform on the chip."""
+    from predictionio_tpu.ops.device_prep import build_buckets
+
+    k = config.rank
+    host_u = (np.asarray(user_ids, dtype=np.int32)
+              if isinstance(user_ids, np.ndarray) else None)
+    host_i = (np.asarray(item_ids, dtype=np.int32)
+              if isinstance(item_ids, np.ndarray) else None)
+    if host_ids is not None:
+        host_u = np.asarray(host_ids[0], dtype=np.int32)
+        host_i = np.asarray(host_ids[1], dtype=np.int32)
+    # The DEVICE data always comes from user_ids/item_ids — host_ids is a
+    # stats-only hint; feeding it to jnp.asarray would re-upload the COO
+    # a second time when the caller already device_put it.
+    rows_u = jnp.asarray(user_ids if not isinstance(user_ids, np.ndarray)
+                         else np.asarray(user_ids, dtype=np.int32),
+                         dtype=jnp.int32)
+    rows_i = jnp.asarray(item_ids if not isinstance(item_ids, np.ndarray)
+                         else np.asarray(item_ids, dtype=np.int32),
+                         dtype=jnp.int32)
+    if ratings is None:
+        vals = jnp.ones(rows_u.shape[0], jnp.float32)
+    else:
+        vals = jnp.asarray(ratings, dtype=jnp.float32)
+
+    plan_u = _plan_side(rows_u, n_users, config, host_rows=host_u)
+    plan_i = _plan_side(rows_i, n_items, config, host_rows=host_i)
 
     # The build program emits BUCKET-level arrays (chunk slicing happens
     # in-graph inside the training loop — see _expand_chunks); its compile
     # is the cold-start wall on this backend (serialized, uncacheable), so
-    # every op it doesn't contain is ~1 s saved.  AOT executables bypass
-    # the jit cache, so memoize per (plan, nnz) — warm re-preps (retrains,
-    # the bench's second pass) skip the compile.  The two sides' compiles
-    # are issued concurrently; a backend whose compile service can
-    # parallelize overlaps them (this tunnel serializes them — measured).
+    # every op it doesn't contain is ~1 s saved.  BOTH sides compile as
+    # ONE program: the backend's compile service serializes separate
+    # requests (user+item measured 50-77 s as a pair at the ML-25M shape)
+    # while the merged program compiles in 38 s at the same low effort,
+    # with identical exec time.  AOT executables bypass the jit cache, so
+    # memoize per (plans, nnz) — warm re-preps (retrains, the bench's
+    # second pass) skip the compile.  The factor init runs while the
+    # build compiles (compilation is server-side; the device is free).
     import concurrent.futures
 
     build_u = dataclasses.replace(plan_u, plain_chunks=(), split_chunks=())
     build_i = dataclasses.replace(plan_i, plain_chunks=(), split_chunks=())
-    jitted = jax.jit(build_buckets.__wrapped__, static_argnames=("plan",))
-    nnz = rows_u.shape[0]
-    co_u = _build_cache_get((build_u, nnz))
-    co_i = _build_cache_get((build_i, nnz))
-    todo = []
-    if co_u is None:
-        todo.append(("u", jitted.lower(rows_u, rows_i, vals, plan=build_u)))
-    if co_i is None:
-        todo.append(("i", jitted.lower(rows_i, rows_u, vals, plan=build_i)))
-    if todo:
-        with concurrent.futures.ThreadPoolExecutor(max(len(todo), 1)) as ex:
-            done = dict(zip((t[0] for t in todo),
-                            ex.map(lambda t: t[1].compile(), todo)))
-        if "u" in done:
-            co_u = done["u"]
-            _build_cache_put((build_u, nnz), co_u)
-        if "i" in done:
-            co_i = done["i"]
-            _build_cache_put((build_i, nnz), co_i)
 
-    def one_side(compiled, rows, cols, plan):
-        plain, split = compiled(rows, cols, vals)
+    def build_both(ru, ri, v, *, pu, pi):
+        return (build_buckets.__wrapped__(ru, ri, v, pu),
+                build_buckets.__wrapped__(ri, ru, v, pi))
+
+    nnz = rows_u.shape[0]
+    co = _build_cache_get((build_u, build_i, nnz))
+    pend = None
+    if co is None:
+        lowered = jax.jit(build_both, static_argnames=("pu", "pi")).lower(
+            rows_u, rows_i, vals, pu=build_u, pi=build_i)
+        ex = concurrent.futures.ThreadPoolExecutor(1)
+        pend = ex.submit(_compile_build, lowered)
+        ex.shutdown(wait=False)
+
+    # Fire the fused-loop compile from plan-derived shapes — its ~75 s
+    # cold compile overlaps prep execution and whatever the caller does
+    # before training, and the resulting EXECUTABLE is handed to
+    # train_als_prepared through the future.  Submitted AFTER the build
+    # compile so the (~2-worker, serializing) compile service finishes
+    # the build first: loop-first measured prep_cold 81 s vs ~45 s this
+    # way.  LRU'd so warm re-preps (retrains, the bench's second pass)
+    # reuse the executable instead of re-lowering.
+    # Key on exactly what the lowering consumes (plans + dims + the
+    # statics-determining config fields): keying on the whole config made
+    # a seed sweep recompile a byte-identical program per seed.
+    warm_key = (plan_u, plan_i, n_users, n_items, config.rank,
+                config.implicit, _resolve_gram_dtype(config.gram_dtype),
+                config.solver, config.use_pallas)
+    fut = _warm_cache_get(warm_key)
+    if fut is not None and fut.done() and fut.result() is None:
+        fut = None  # failed pre-warm: retry rather than stay poisoned
+    if fut is None:
+        fut = concurrent.futures.Future()
+        _warm_cache_put(warm_key, fut)
+        try:
+            loop_statics, loop_lowered = _lower_train_loop_from_plans(
+                config, plan_u, plan_i, n_users, n_items)
+            threading.Thread(target=_compile_train_loop,
+                             args=(loop_statics, loop_lowered, fut),
+                             daemon=True).start()
+        except Exception:
+            logging.getLogger(__name__).debug("loop pre-warm lower failed",
+                                              exc_info=True)
+            fut.set_result(None)
+
+    uf, itf = _init_factors(n_users, n_items, k, config.seed)
+
+    if pend is not None:
+        co = pend.result()
+        _build_cache_put((build_u, build_i, nnz), co)
+
+    side_u, side_i = co(rows_u, rows_i, vals)
+
+    def one_side(built, plan):
+        plain, split = built
         out = [("plain", *b) for b in plain]
         specs = [("plain", ch) for ch in plan.plain_chunks]
         if split is not None:
@@ -624,16 +809,12 @@ def _prepare_als_inputs_device(
             specs.append(("merged", plan.pad_rows_to, plan.split_chunks))
         return out, tuple(specs)
 
-    user_buckets, spec_u = one_side(co_u, rows_u, rows_i, plan_u)
-    item_buckets, spec_i = one_side(co_i, rows_i, rows_u, plan_i)
-    inputs = ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
-                       item_buckets=item_buckets, n_users=n_users,
-                       n_items=n_items, chunk_specs=(spec_u, spec_i))
-    # Overlap the (~70 s cold) fused-loop compile with whatever the caller
-    # does next — prep read-backs, checkpoint setup, eval prep.
-    threading.Thread(target=_warm_train_loop, args=(inputs, config),
-                     daemon=True).start()
-    return inputs
+    user_buckets, spec_u = one_side(side_u, plan_u)
+    item_buckets, spec_i = one_side(side_i, plan_i)
+    return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
+                     item_buckets=item_buckets, n_users=n_users,
+                     n_items=n_items, chunk_specs=(spec_u, spec_i),
+                     loop_warm=fut)
 
 
 def train_als(
@@ -696,7 +877,19 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     # of silently replicating it after the scatter.
     factor_shardings = (_factor_constraint(uf), _factor_constraint(itf))
 
+    # Use the pre-warm's executable when it compiled EXACTLY this program
+    # (same statics, meshless): the train then waits on the overlapped
+    # compile instead of issuing its own — immune to whatever caching or
+    # queueing the backend's compile service does.
+    warm_exe = None
+    if inputs.loop_warm is not None and factor_shardings == (None, None):
+        warm = inputs.loop_warm.result()  # blocks only while still compiling
+        if warm is not None and warm[0] == statics:
+            warm_exe = warm[1]
+
     def sweeps(uf, itf, n):
+        if warm_exe is not None:
+            return warm_exe(uf, itf, ubk, ibk, reg, alpha, jnp.int32(n))
         return _train_loop(
             uf, itf, ubk, ibk, reg, alpha, jnp.int32(n),
             factor_shardings=factor_shardings, **statics)
@@ -838,29 +1031,6 @@ def _resolve_loop_statics(config: ALSConfig, user_buckets, item_buckets,
         solver=solver,
         chunk_specs=chunk_specs,
     )
-
-
-def _warm_train_loop(inputs: "ALSInputs", config: ALSConfig) -> None:
-    """Fire-and-forget compile of the fused loop for these inputs.
-
-    A ZERO-iteration call populates the jit cache (the loop bound is a
-    traced scalar, so iterations=0 shares the compiled program with the
-    real run) without executing any sweep.  Called from device prep on a
-    background thread so the ~70 s loop compile overlaps prep execution —
-    a cold first `pio train` pays max(prep, loop) instead of their sum.
-    """
-    try:
-        statics = _resolve_loop_statics(config, inputs.user_buckets,
-                                        inputs.item_buckets,
-                                        inputs.chunk_specs)
-        _train_loop(inputs.uf0, inputs.itf0,
-                    tuple(tuple(b[1:]) for b in inputs.user_buckets),
-                    tuple(tuple(b[1:]) for b in inputs.item_buckets),
-                    jnp.float32(config.reg), jnp.float32(config.alpha),
-                    jnp.int32(0), factor_shardings=(None, None), **statics)
-    except Exception:  # pre-warm must never sink a train
-        logging.getLogger(__name__).debug("loop pre-warm failed",
-                                          exc_info=True)
 
 
 @functools.partial(jax.jit, static_argnames=(

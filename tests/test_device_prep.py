@@ -127,3 +127,50 @@ class TestTrainWithDevicePrep:
         m = train_als(rows, cols, vals, 64, 64, cfg)
         assert np.isfinite(np.asarray(m.user_factors)).all()
         assert np.isfinite(np.asarray(m.item_factors)).all()
+
+
+class TestPlanShapeLockstep:
+    def test_plan_bucket_shapes_match_build(self):
+        """_plan_bucket_shapes (the loop pre-warm's shape oracle) must stay
+        in lock-step with what the prep path actually emits — the pre-warm
+        compiles the training loop from these shapes BEFORE prep runs, and
+        a drift would silently turn the overlapped compile into a wasted
+        one plus a second, serial compile."""
+        from predictionio_tpu.models.als import (
+            _plan_bucket_shapes, _plan_side, prepare_als_inputs,
+        )
+
+        rows, cols, vals = _coo(seed=7, n_rows=96, n_cols=64, n=9000,
+                                zipf=1.2)
+        cfg = ALSConfig(rank=8, iterations=1, seed=1, device_prep=True,
+                        split_above=32, max_block_floats=1 << 14)
+        inputs = prepare_als_inputs(rows, cols, vals, 96, 64, cfg)
+        plan_u = _plan_side(jnp.asarray(rows, jnp.int32), 96, cfg)
+        plan_i = _plan_side(jnp.asarray(cols, jnp.int32), 64, cfg)
+        for plan, buckets, specs in (
+                (plan_u, inputs.user_buckets, inputs.chunk_specs[0]),
+                (plan_i, inputs.item_buckets, inputs.chunk_specs[1])):
+            shapes, spec_pred = _plan_bucket_shapes(plan)
+            assert spec_pred == specs
+            assert len(shapes) == len(buckets)
+            for pred, real in zip(shapes, buckets):
+                assert pred[0] == real[0]  # kind
+                assert len(pred) == len(real)
+                for s, a in zip(pred[1:], real[1:]):
+                    assert s.shape == a.shape, (s.shape, a.shape)
+                    assert s.dtype == a.dtype, (s.dtype, a.dtype)
+        # At least one merged bucket must have been exercised.
+        assert any(b[0] == "merged" for b in inputs.user_buckets)
+
+    def test_host_stats_match_device_stats(self):
+        """_plan_side(host_rows=...) must yield the IDENTICAL BucketPlan
+        to the device stats path — the plan keys the build/warm caches and
+        any drift would silently compile two programs per dataset."""
+        from predictionio_tpu.models.als import _plan_side
+
+        rows, _, _ = _coo(seed=11, n_rows=200, n_cols=50, n=30_000, zipf=1.2)
+        cfg = ALSConfig(rank=8, split_above=64, max_block_floats=1 << 14)
+        dev_plan = _plan_side(jnp.asarray(rows, jnp.int32), 200, cfg)
+        host_plan = _plan_side(jnp.asarray(rows, jnp.int32), 200, cfg,
+                               host_rows=rows)
+        assert dev_plan == host_plan

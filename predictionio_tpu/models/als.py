@@ -457,8 +457,10 @@ class ALSInputs:
     # ("merged", pad_to, ((e0, e1, r0, r1), ...)); None = pre-chunked.
     chunk_specs: Optional[Tuple[Tuple, Tuple]] = None
     # Future resolving to (statics, compiled loop executable) from the
-    # plan-shape pre-warm, or None; see _warm_train_loop_from_plans.
+    # plan-shape pre-warm, or None; loop_warm_statics mirrors the statics
+    # the pre-warm lowered so a mismatched train can skip the wait.
     loop_warm: Optional[object] = None
+    loop_warm_statics: Optional[dict] = None
 
 
 def prepare_als_inputs(
@@ -609,7 +611,13 @@ def _plan_side(rows: jax.Array, n_rows: int, config: ALSConfig,
         # Exact replica of ops.device_prep.degree_histogram: counts over
         # ALL n_rows entities (zero-degree included), degrees clipped at
         # the cap into cap+1 bins, over-cap degrees in entity-id order.
-        counts = np.bincount(np.asarray(host_rows), minlength=n_rows)
+        # Out-of-range ids are DROPPED like the device scatter-add drops
+        # them (np.bincount would raise on negatives / grow on overflow).
+        host_rows = np.asarray(host_rows)
+        in_range = (host_rows >= 0) & (host_rows < n_rows)
+        if not in_range.all():
+            host_rows = host_rows[in_range]
+        counts = np.bincount(host_rows, minlength=n_rows)
         hist = np.bincount(np.minimum(counts, split_above),
                            minlength=split_above + 1)
         over = counts > split_above
@@ -707,22 +715,23 @@ def _prepare_als_inputs_device(
     from predictionio_tpu.ops.device_prep import build_buckets
 
     k = config.rank
-    host_u = (np.asarray(user_ids, dtype=np.int32)
-              if isinstance(user_ids, np.ndarray) else None)
-    host_i = (np.asarray(item_ids, dtype=np.int32)
-              if isinstance(item_ids, np.ndarray) else None)
-    if host_ids is not None:
-        host_u = np.asarray(host_ids[0], dtype=np.int32)
-        host_i = np.asarray(host_ids[1], dtype=np.int32)
     # The DEVICE data always comes from user_ids/item_ids — host_ids is a
     # stats-only hint; feeding it to jnp.asarray would re-upload the COO
-    # a second time when the caller already device_put it.
-    rows_u = jnp.asarray(user_ids if not isinstance(user_ids, np.ndarray)
-                         else np.asarray(user_ids, dtype=np.int32),
-                         dtype=jnp.int32)
-    rows_i = jnp.asarray(item_ids if not isinstance(item_ids, np.ndarray)
-                         else np.asarray(item_ids, dtype=np.int32),
-                         dtype=jnp.int32)
+    # a second time when the caller already device_put it.  Numpy inputs
+    # convert to int32 ONCE and serve both the upload and the host stats.
+    def one_input(ids, hint):
+        if hint is not None:
+            return np.asarray(hint, dtype=np.int32), jnp.asarray(
+                ids, dtype=jnp.int32)
+        if isinstance(ids, np.ndarray):
+            h = np.asarray(ids, dtype=np.int32)
+            return h, jnp.asarray(h)
+        return None, jnp.asarray(ids, dtype=jnp.int32)
+
+    host_u, rows_u = one_input(user_ids,
+                               host_ids[0] if host_ids else None)
+    host_i, rows_i = one_input(item_ids,
+                               host_ids[1] if host_ids else None)
     if ratings is None:
         vals = jnp.ones(rows_u.shape[0], jnp.float32)
     else:
@@ -775,12 +784,13 @@ def _prepare_als_inputs_device(
     warm_key = (plan_u, plan_i, n_users, n_items, config.rank,
                 config.implicit, _resolve_gram_dtype(config.gram_dtype),
                 config.solver, config.use_pallas)
-    fut = _warm_cache_get(warm_key)
-    if fut is not None and fut.done() and fut.result() is None:
-        fut = None  # failed pre-warm: retry rather than stay poisoned
-    if fut is None:
+    cached = _warm_cache_get(warm_key)
+    if cached is not None and cached[0].done() \
+            and cached[0].result() is None:
+        cached = None  # failed pre-warm: retry rather than stay poisoned
+    if cached is None:
         fut = concurrent.futures.Future()
-        _warm_cache_put(warm_key, fut)
+        loop_statics = None
         try:
             loop_statics, loop_lowered = _lower_train_loop_from_plans(
                 config, plan_u, plan_i, n_users, n_items)
@@ -791,6 +801,12 @@ def _prepare_als_inputs_device(
             logging.getLogger(__name__).debug("loop pre-warm lower failed",
                                               exc_info=True)
             fut.set_result(None)
+        # Statics stored ALONGSIDE the future so a train with different
+        # statics can skip the wait without blocking on a compile it
+        # would discard.
+        cached = (fut, loop_statics)
+        _warm_cache_put(warm_key, cached)
+    fut, warm_statics = cached
 
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
 
@@ -814,7 +830,7 @@ def _prepare_als_inputs_device(
     return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
                      item_buckets=item_buckets, n_users=n_users,
                      n_items=n_items, chunk_specs=(spec_u, spec_i),
-                     loop_warm=fut)
+                     loop_warm=fut, loop_warm_statics=warm_statics)
 
 
 def train_als(
@@ -882,7 +898,8 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     # compile instead of issuing its own — immune to whatever caching or
     # queueing the backend's compile service does.
     warm_exe = None
-    if inputs.loop_warm is not None and factor_shardings == (None, None):
+    if (inputs.loop_warm is not None and factor_shardings == (None, None)
+            and inputs.loop_warm_statics == statics):
         warm = inputs.loop_warm.result()  # blocks only while still compiling
         if warm is not None and warm[0] == statics:
             warm_exe = warm[1]

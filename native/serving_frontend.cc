@@ -47,6 +47,7 @@ namespace {
 
 struct Pending {
   std::string body;
+  std::string route;  // "METHOD PATH?QUERY" — routing metadata for Python
   std::string response;
   int status = 500;
   bool done = false;
@@ -117,9 +118,12 @@ void http_reply(int fd, int status, const char* ctype, const std::string& body,
   const char* reason = status == 200   ? "OK"
                        : status == 201 ? "Created"
                        : status == 400 ? "Bad Request"
+                       : status == 401 ? "Unauthorized"
+                       : status == 403 ? "Forbidden"
                        : status == 404 ? "Not Found"
+                       : status == 500 ? "Internal Server Error"
                        : status == 503 ? "Service Unavailable"
-                                       : "Internal Server Error";
+                                       : "Error";
   char head[256];
   int n = snprintf(head, sizeof(head),
                    "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
@@ -166,9 +170,7 @@ bool read_request(int fd, std::string& carry, std::string& method,
   size_t sp2 = head.find(' ', sp1 + 1);
   if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
   method = head.substr(0, sp1);
-  path = head.substr(sp1 + 1, sp2 - sp1 - 1);
-  size_t q = path.find('?');
-  if (q != std::string::npos) path.resize(q);
+  path = head.substr(sp1 + 1, sp2 - sp1 - 1);  // query string INCLUDED
 
   size_t content_length = 0;
   want_close = false;
@@ -213,10 +215,11 @@ bool handle_one(Frontend* fe, int fd, std::string& carry) {
     return false;
   bool keep = !want_close;
   fe->n_requests++;
-  if (method == "GET" && path == "/") {
+  std::string bare = path.substr(0, path.find('?'));
+  if (method == "GET" && bare == "/") {
     http_reply(fd, 200, "application/json",
                "{\"status\":\"alive\",\"frontend\":\"native\"}", keep);
-  } else if (method == "GET" && path == "/metrics") {
+  } else if (method == "GET" && bare == "/metrics") {
     char m[640];
     uint64_t nb = fe->n_batches.load(), br = fe->batch_rows.load();
     snprintf(m, sizeof(m),
@@ -232,9 +235,15 @@ bool handle_one(Frontend* fe, int fd, std::string& carry) {
              nb ? (double)br / nb : 0.0,
              (unsigned long long)fe->live_conns.load());
     http_reply(fd, 200, "text/plain; version=0.0.4", m, keep);
-  } else if (method == "POST" && path == "/queries.json") {
+  } else {
+    // Everything else — /queries.json, /events.json, /batch/events.json,
+    // webhooks, reload — rides the batcher: concurrent requests aggregate
+    // into one Python callback (one GIL entry; the event server turns
+    // same-route single-event POSTs into ONE group-committed insert).
     Pending p;
     p.body.swap(body);
+    p.route.reserve(method.size() + 1 + path.size());
+    p.route.append(method).append(" ").append(path);
     bool queued = false;
     {
       std::lock_guard<std::mutex> lk(fe->qmu);
@@ -259,9 +268,6 @@ bool handle_one(Frontend* fe, int fd, std::string& carry) {
     }
     if (p.status >= 400) fe->n_errors++;
     http_reply(fd, p.status, "application/json; charset=UTF-8", p.response,
-               keep);
-  } else {
-    http_reply(fd, 404, "application/json", "{\"message\":\"Not Found\"}",
                keep);
   }
   return keep && fe->running.load();
@@ -436,6 +442,15 @@ const char* pio_batch_request(void* batch_handle, int i, int* len_out) {
   if (i < 0 || i >= (int)b->items.size()) return nullptr;
   if (len_out) *len_out = (int)b->items[i]->body.size();
   return b->items[i]->body.c_str();
+}
+
+const char* pio_batch_route(void* batch_handle, int i, int* len_out) {
+  // "METHOD PATH?QUERY" for item i — lets the Python callback dispatch
+  // beyond /queries.json (event ingest, webhooks, reload).
+  auto* b = static_cast<Batch*>(batch_handle);
+  if (i < 0 || i >= (int)b->items.size()) return nullptr;
+  if (len_out) *len_out = (int)b->items[i]->route.size();
+  return b->items[i]->route.c_str();
 }
 
 void pio_batch_respond(void* batch_handle, int i, const char* data, int len,

@@ -315,9 +315,27 @@ def cmd_build(args) -> int:
 # --------------------------------------------------------------------------
 
 def cmd_eventserver(args) -> int:
+    import time as _time
+
     from predictionio_tpu.server import EventServer
 
     srv = EventServer(storage=_storage(), host=args.ip, port=args.port)
+    if getattr(args, "native", False):
+        # C++ continuous-batching frontend: concurrent single-event POSTs
+        # aggregate into ONE group-committed insert per callback.
+        from predictionio_tpu.native.frontend import NativeFrontend
+
+        fe = NativeFrontend(None, host=args.ip, port=args.port,
+                            fallback_batch=srv.native_fallback_batch)
+        fe.start()
+        print(f"Event Server (native frontend) listening on "
+              f"{args.ip}:{fe.port} (Ctrl-C to stop)")
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            fe.stop()
+        return 0
     srv.start(block=False)
     print(f"Event Server listening on {args.ip}:{srv.port} "
           "(Ctrl-C to stop)")
@@ -655,6 +673,9 @@ def build_parser() -> argparse.ArgumentParser:
     es = sub.add_parser("eventserver", help="start the event ingestion server")
     es.add_argument("--ip", default="0.0.0.0")
     es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--native", action="store_true",
+                    help="serve through the C++ continuous-batching "
+                         "frontend (group-committed ingest)")
     es.set_defaults(fn=cmd_eventserver)
 
     d = sub.add_parser("deploy", help="serve a trained engine over HTTP")

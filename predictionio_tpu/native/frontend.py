@@ -28,14 +28,25 @@ _BATCH_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int)
 class NativeFrontend:
     """Wraps pio_frontend_* for a batch-handler function.
 
-    ``handler(batch: List[dict]) -> List[Any]`` maps parsed query JSONs to
-    JSON-able results, one per input (exceptions → per-item 500s).
+    ``handler(batch: List[dict]) -> List[Any]`` maps parsed query JSONs
+    (POST /queries.json) to JSON-able results, one per input (exceptions
+    → per-item 500s).  ``fallback(method, path_with_query, body) ->
+    (status, payload)`` answers every OTHER route the C++ layer forwards
+    (event ingest, webhooks, reload, …); without one those routes 404.
+    Same-route fallback items within a batch are handed to
+    ``fallback_batch(method, path, bodies) -> [(status, payload), ...]``
+    when provided — the event server uses this for group-committed
+    ingest.
     """
 
-    def __init__(self, handler: Callable[[List[Any]], List[Any]],
+    def __init__(self, handler: Optional[Callable[[List[Any]], List[Any]]],
                  host: str = "0.0.0.0", port: int = 8000,
                  max_batch: int = 64, max_wait_us: int = 2000,
-                 n_batchers: int = 4):
+                 n_batchers: int = 4,
+                 fallback: Optional[Callable[[str, str, bytes],
+                                             Any]] = None,
+                 fallback_batch: Optional[Callable[[str, str, List[bytes]],
+                                                   List[Any]]] = None):
         lib = load_library("serving_frontend")
         if lib is None:
             raise RuntimeError("native frontend unavailable (g++ build failed)")
@@ -46,11 +57,16 @@ class NativeFrontend:
         lib.pio_batch_request.restype = ctypes.c_char_p
         lib.pio_batch_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.POINTER(ctypes.c_int)]
+        lib.pio_batch_route.restype = ctypes.c_char_p
+        lib.pio_batch_route.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_int)]
         lib.pio_batch_respond.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_char_p, ctypes.c_int,
                                           ctypes.c_int]
         self._lib = lib
         self._handler = handler
+        self._fallback = fallback
+        self._fallback_batch = fallback_batch
         self._host = host
         self._requested_port = port
         self.port: Optional[int] = None
@@ -68,10 +84,84 @@ class NativeFrontend:
     def _on_batch(self, batch_handle, n: int) -> None:
         try:
             datas: List[bytes] = []
+            routes: List[str] = []
             for i in range(n):
                 ln = ctypes.c_int(0)
                 datas.append(self._lib.pio_batch_request(
                     batch_handle, i, ctypes.byref(ln)) or b"null")
+                routes.append((self._lib.pio_batch_route(
+                    batch_handle, i, ctypes.byref(ln)) or b"").decode(
+                        "utf-8", "replace"))
+
+            # Split query-path items from everything else the C++ layer
+            # forwarded (event ingest, webhooks, reload, ...).  With no
+            # query handler (event-server mode) EVERY item is fallback.
+            fb_idx = [i for i, r in enumerate(routes)
+                      if self._handler is None
+                      or r.split(" ", 1)[-1].split("?", 1)[0]
+                      != "/queries.json"]
+            if fb_idx:
+                self._dispatch_mixed(batch_handle, n, datas, routes,
+                                     set(fb_idx))
+                return
+            self._answer_queries(batch_handle, range(n), datas)
+        except Exception:
+            logger.exception("native frontend callback error")
+
+    def _dispatch_mixed(self, batch_handle, n, datas, routes, fb_set):
+        results: List[Any] = [None] * n
+        # Consecutive same-route fallback runs batch together (the event
+        # server group-commits a run of POST /events.json singles).
+        i = 0
+        while i < n:
+            if i not in fb_set:
+                i += 1
+                continue
+            j = i
+            while j < n and j in fb_set and routes[j] == routes[i]:
+                j += 1
+            method, _, path = routes[i].partition(" ")
+            group = list(range(i, j))
+            try:
+                if self._fallback_batch is not None:
+                    outs = self._fallback_batch(method, path,
+                                                [datas[g] for g in group])
+                elif self._fallback is not None:
+                    outs = [self._fallback(method, path, datas[g])
+                            for g in group]
+                else:
+                    outs = [(404, {"message": "Not Found"})] * len(group)
+                # Every item MUST get a response: an unanswered Pending
+                # blocks its C++ worker forever (and stop() then deadlocks
+                # joining it), so a miscounting handler fails safe here.
+                if len(outs) != len(group) or any(
+                        not isinstance(o, tuple) or len(o) != 2
+                        for o in outs):
+                    raise ValueError(
+                        f"fallback returned {len(outs)} results for "
+                        f"{len(group)} requests")
+            except Exception:
+                logger.exception("fallback handler failed")
+                outs = [(500, {"message": "Internal server error."})] \
+                    * len(group)
+            for g, out in zip(group, outs):
+                results[g] = out
+            i = j
+        for i, res in enumerate(results):
+            if res is None:
+                continue
+            status, payload = res
+            body = json.dumps(payload).encode()
+            self._lib.pio_batch_respond(batch_handle, i, body, len(body),
+                                        status)
+        q_idx = [i for i in range(n) if i not in fb_set]
+        if q_idx:
+            self._answer_queries(batch_handle, q_idx,
+                                 [datas[i] for i in q_idx])
+
+    def _answer_queries(self, batch_handle, idxs, datas) -> None:
+        idxs = list(idxs)
+        try:
             raw: List[Optional[dict]] = []
             try:
                 # One C-level parse for the whole batch instead of n
@@ -79,7 +169,7 @@ class NativeFrontend:
                 raw = json.loads(b"[" + b",".join(datas) + b"]")
             except json.JSONDecodeError:
                 raw = []
-            if len(raw) != n:
+            if len(raw) != len(idxs):
                 # Parse failed — or a crafted body like '1,2' smuggled
                 # EXTRA array elements through the join, which would
                 # misalign every response in the batch.
@@ -90,24 +180,24 @@ class NativeFrontend:
                     except json.JSONDecodeError:
                         raw.append(None)
             # Malformed JSON answered inline; valid ones go to the handler.
-            valid_idx = [i for i, r in enumerate(raw) if r is not None]
-            results: List[Any] = [None] * n
-            if valid_idx:
+            valid = [k for k, r in enumerate(raw) if r is not None]
+            results: List[Any] = [None] * len(idxs)
+            if valid:
                 try:
-                    outs = self._handler([raw[i] for i in valid_idx])
-                    for i, out in zip(valid_idx, outs):
-                        results[i] = (200, out)
+                    outs = self._handler([raw[k] for k in valid])
+                    for k, out in zip(valid, outs):
+                        results[k] = (200, out)
                 except Exception:
                     logger.exception("batch handler failed")
-                    for i in valid_idx:
-                        results[i] = (500, {"message": "Internal server error."})
-            for i in range(n):
-                if raw[i] is None:
-                    results[i] = (400, {"message": "Invalid JSON."})
-            for i, (status, payload) in enumerate(results):
+                    for k in valid:
+                        results[k] = (500, {"message": "Internal server error."})
+            for k in range(len(idxs)):
+                if raw[k] is None:
+                    results[k] = (400, {"message": "Invalid JSON."})
+            for k, (status, payload) in enumerate(results):
                 body = json.dumps(payload).encode()
-                self._lib.pio_batch_respond(batch_handle, i, body, len(body),
-                                            status)
+                self._lib.pio_batch_respond(batch_handle, idxs[k], body,
+                                            len(body), status)
         except Exception:
             logger.exception("native frontend callback error")
 

@@ -366,6 +366,17 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
                             f"ingest POST {path.split('?')[0]} -> {status}")
                     head = buf[:end].lower()
                     i = head.find(b"content-length:")
+                    if i < 0:
+                        # Malformed reply is a SERVER anomaly: drop the
+                        # conn and surface it — resending could duplicate
+                        # a committed event.
+                        try:
+                            getattr(local, attr).close()
+                        except Exception:
+                            pass
+                        setattr(local, attr, None)
+                        raise RuntimeError(
+                            f"no Content-Length in reply: {head[:120]!r}")
                     stop = head.find(b"\r", i)
                     if stop < 0:
                         stop = len(head)

@@ -22,8 +22,11 @@
 // reads/writes requests through the pio_batch_* accessors, so no memory
 // crosses allocator boundaries.
 //
-// Endpoints: POST /queries.json (batched), GET / (status), GET /metrics
-// (Prometheus text). Everything else 404s.
+// Endpoints: GET / (status) and GET /metrics (Prometheus text) are answered
+// here unless forward_all is set (event-server mode); EVERY other request
+// rides the batcher into the Python callback with "METHOD PATH?QUERY"
+// routing metadata (pio_batch_route), so the full engine/event APIs work
+// behind this frontend.
 
 #include <arpa/inet.h>
 #include <atomic>
@@ -49,6 +52,7 @@ struct Pending {
   std::string body;
   std::string route;  // "METHOD PATH?QUERY" — routing metadata for Python
   std::string response;
+  std::string ctype = "application/json; charset=UTF-8";
   int status = 500;
   bool done = false;
   std::mutex mu;
@@ -72,6 +76,7 @@ struct Frontend {
   int max_batch = 8;
   int max_wait_us = 2000;
   int n_batchers = 4;
+  bool forward_all = false;  // event-server mode: / and /metrics go to Python
   BatchCb cb = nullptr;
 
   std::atomic<bool> running{false};
@@ -216,10 +221,10 @@ bool handle_one(Frontend* fe, int fd, std::string& carry) {
   bool keep = !want_close;
   fe->n_requests++;
   std::string bare = path.substr(0, path.find('?'));
-  if (method == "GET" && bare == "/") {
+  if (!fe->forward_all && method == "GET" && bare == "/") {
     http_reply(fd, 200, "application/json",
                "{\"status\":\"alive\",\"frontend\":\"native\"}", keep);
-  } else if (method == "GET" && bare == "/metrics") {
+  } else if (!fe->forward_all && method == "GET" && bare == "/metrics") {
     char m[640];
     uint64_t nb = fe->n_batches.load(), br = fe->batch_rows.load();
     snprintf(m, sizeof(m),
@@ -267,8 +272,7 @@ bool handle_one(Frontend* fe, int fd, std::string& carry) {
       p.cv.wait(lk, [&] { return p.done; });
     }
     if (p.status >= 400) fe->n_errors++;
-    http_reply(fd, p.status, "application/json; charset=UTF-8", p.response,
-               keep);
+    http_reply(fd, p.status, p.ctype.c_str(), p.response, keep);
   }
   return keep && fe->running.load();
 }
@@ -390,9 +394,11 @@ void acceptor_loop(Frontend* fe) {
 extern "C" {
 
 int pio_frontend_start(const char* host, int port, int max_batch,
-                       int max_wait_us, int n_batchers, BatchCb cb) {
+                       int max_wait_us, int n_batchers, int forward_all,
+                       BatchCb cb) {
   if (g_frontend) return -1;
   auto* fe = new Frontend();
+  fe->forward_all = forward_all != 0;
   fe->max_batch = max_batch > 0 ? max_batch : 8;
   fe->max_wait_us = max_wait_us;
   fe->n_batchers = n_batchers > 0 ? n_batchers : 4;
@@ -454,13 +460,14 @@ const char* pio_batch_route(void* batch_handle, int i, int* len_out) {
 }
 
 void pio_batch_respond(void* batch_handle, int i, const char* data, int len,
-                       int status) {
+                       int status, const char* ctype) {
   auto* b = static_cast<Batch*>(batch_handle);
   if (i < 0 || i >= (int)b->items.size()) return;
   Pending* p = b->items[i];
   {
     std::lock_guard<std::mutex> lk(p->mu);
     p->response.assign(data, len);
+    if (ctype && *ctype) p->ctype = ctype;
     p->status = status;
     p->done = true;
     p->cv.notify_one();  // under p->mu: p may be destroyed once we release

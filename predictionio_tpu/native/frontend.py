@@ -53,7 +53,7 @@ class NativeFrontend:
         lib.pio_frontend_start.restype = ctypes.c_int
         lib.pio_frontend_start.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, _BATCH_CB]
+            ctypes.c_int, ctypes.c_int, _BATCH_CB]
         lib.pio_batch_request.restype = ctypes.c_char_p
         lib.pio_batch_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.POINTER(ctypes.c_int)]
@@ -62,7 +62,7 @@ class NativeFrontend:
                                         ctypes.POINTER(ctypes.c_int)]
         lib.pio_batch_respond.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_char_p, ctypes.c_int,
-                                          ctypes.c_int]
+                                          ctypes.c_int, ctypes.c_char_p]
         self._lib = lib
         self._handler = handler
         self._fallback = fallback
@@ -88,16 +88,18 @@ class NativeFrontend:
             for i in range(n):
                 ln = ctypes.c_int(0)
                 datas.append(self._lib.pio_batch_request(
-                    batch_handle, i, ctypes.byref(ln)) or b"null")
+                    batch_handle, i, ctypes.byref(ln)) or b"")
                 routes.append((self._lib.pio_batch_route(
                     batch_handle, i, ctypes.byref(ln)) or b"").decode(
                         "utf-8", "replace"))
 
-            # Split query-path items from everything else the C++ layer
-            # forwarded (event ingest, webhooks, reload, ...).  With no
-            # query handler (event-server mode) EVERY item is fallback.
+            # Split query-path items (POST only, like the python server)
+            # from everything else the C++ layer forwarded (event ingest,
+            # webhooks, reload, ...).  With no query handler
+            # (event-server mode) EVERY item is fallback.
             fb_idx = [i for i, r in enumerate(routes)
                       if self._handler is None
+                      or not r.startswith("POST ")
                       or r.split(" ", 1)[-1].split("?", 1)[0]
                       != "/queries.json"]
             if fb_idx:
@@ -150,10 +152,9 @@ class NativeFrontend:
         for i, res in enumerate(results):
             if res is None:
                 continue
-            status, payload = res
-            body = json.dumps(payload).encode()
+            status, body, ctype = self._encode(res)
             self._lib.pio_batch_respond(batch_handle, i, body, len(body),
-                                        status)
+                                        status, ctype)
         q_idx = [i for i in range(n) if i not in fb_set]
         if q_idx:
             self._answer_queries(batch_handle, q_idx,
@@ -165,8 +166,10 @@ class NativeFrontend:
             raw: List[Optional[dict]] = []
             try:
                 # One C-level parse for the whole batch instead of n
-                # json.loads calls under the GIL.
-                raw = json.loads(b"[" + b",".join(datas) + b"]")
+                # json.loads calls under the GIL.  Empty bodies become
+                # `null` placeholders (-> per-item 400 below).
+                raw = json.loads(b"[" + b",".join(d if d else b"null"
+                                                 for d in datas) + b"]")
             except json.JSONDecodeError:
                 raw = []
             if len(raw) != len(idxs):
@@ -185,6 +188,13 @@ class NativeFrontend:
             if valid:
                 try:
                     outs = self._handler([raw[k] for k in valid])
+                    # Miscounting handlers fail safe (same invariant as
+                    # the fallback path: every Pending MUST be answered
+                    # or its C++ worker blocks forever).
+                    if len(outs) != len(valid):
+                        raise ValueError(
+                            f"handler returned {len(outs)} results for "
+                            f"{len(valid)} queries")
                     for k, out in zip(valid, outs):
                         results[k] = (200, out)
                 except Exception:
@@ -194,19 +204,43 @@ class NativeFrontend:
             for k in range(len(idxs)):
                 if raw[k] is None:
                     results[k] = (400, {"message": "Invalid JSON."})
-            for k, (status, payload) in enumerate(results):
-                body = json.dumps(payload).encode()
+            for k, res in enumerate(results):
+                status, body, ctype = self._encode(res)
                 self._lib.pio_batch_respond(batch_handle, idxs[k], body,
-                                            len(body), status)
+                                            len(body), status, ctype)
         except Exception:
             logger.exception("native frontend callback error")
+
+    @staticmethod
+    def _encode(res) -> "tuple[int, bytes, bytes]":
+        """(status, payload) → (status, body, content-type).
+
+        A non-JSON-able payload must not abort the response loop (every
+        unanswered Pending hangs its C++ worker), so it degrades to a
+        per-item 500.  Text payloads (/metrics expositions) pass through
+        raw with the python HTTP layer's content type.
+        """
+        status, payload = res
+        if isinstance(payload, str):
+            return status, payload.encode(), b"text/plain; version=0.0.4"
+        try:
+            return (status, json.dumps(payload).encode(),
+                    b"application/json; charset=UTF-8")
+        except (TypeError, ValueError):
+            logger.exception("non-serializable response payload")
+            return (500, b'{"message": "Internal server error."}',
+                    b"application/json; charset=UTF-8")
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> int:
+        # Event-server mode (no query handler): / and /metrics forward to
+        # Python too, so the event server's own status page and ingest
+        # metrics stay reachable behind the native layer.
+        forward_all = 1 if self._handler is None else 0
         port = self._lib.pio_frontend_start(
             self._host.encode(), self._requested_port, self.max_batch,
-            self.max_wait_us, self.n_batchers, self._cb)
+            self.max_wait_us, self.n_batchers, forward_all, self._cb)
         if port < 0:
             raise RuntimeError(f"pio_frontend_start failed ({port})")
         self.port = port

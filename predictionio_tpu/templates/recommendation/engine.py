@@ -208,6 +208,10 @@ class ALSAlgorithmParams(Params):
     implicitPrefs: bool = False  # noqa: N815
     maxDegree: Optional[int] = None  # noqa: N815 — ragged truncation cap
     seed: Optional[int] = None
+    # Mesh runs: "auto" row-shards the persistent factor matrices once
+    # they exceed the HBM threshold (blocked ALS, SURVEY §2.4 row 2);
+    # "replicated"/"sharded" force.  Meshless runs ignore it.
+    factorSharding: str = "auto"  # noqa: N815
 
 
 @dataclasses.dataclass
@@ -248,6 +252,7 @@ class ALSAlgorithm(Algorithm):
             implicit=p.implicitPrefs,
             max_degree=p.maxDegree,
             seed=p.seed if p.seed is not None else ctx.seed,
+            factor_sharding=p.factorSharding,
         )
         # `pio train --checkpoint-dir D --checkpoint-every N` (or the
         # PIO_CHECKPOINT_* env pair) makes a killed train resume from the

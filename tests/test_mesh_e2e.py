@@ -163,3 +163,49 @@ def test_mesh_train_matches_meshless(clean_storage, capsys, tmp_path):
     np.testing.assert_allclose(
         [s for _, s in results[0]], [s for _, s in results[1]],
         rtol=2e-4, atol=2e-4)
+
+
+def test_blocked_factor_sharding_via_engine_json(clean_storage, capsys,
+                                                 tmp_path):
+    """engine.json `factorSharding: "sharded"` through the real CLI mesh
+    train must match the meshless model (blocked ALS, SURVEY §2.4 row 2)."""
+    from predictionio_tpu.controller import EngineVariant, load_engine_factory
+    from predictionio_tpu.templates.recommendation import Query
+    from predictionio_tpu.workflow.core_workflow import load_models
+
+    assert pio_main(["app", "new", "meshapp"]) == 0
+    src = tmp_path / "events.ndjson"
+    _write_events_ndjson(src)
+    assert pio_main(["import", "--appid", "1", "--input", str(src)]) == 0
+    variant = tmp_path / "engine.json"
+    variant.write_text(json.dumps({
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "meshapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "numIterations": 6, "lambda_": 0.01,
+                        "seed": 3, "factorSharding": "sharded"}}
+        ],
+    }))
+    assert pio_main(["train", "--engine-json", str(variant)]) == 0
+    assert pio_main(["train", "--engine-json", str(variant),
+                     "--mesh", "data=8"]) == 0
+    capsys.readouterr()
+
+    ev = EngineVariant.from_file(variant)
+    eng = load_engine_factory(ev.engine_factory)()
+    storage = RuntimeContext.create().storage
+    instances = storage.get_engine_instances()
+    all_ids = [i.id for i in instances.get_all()]
+    ctx = RuntimeContext.create(storage=storage)
+    algo = eng.make_algorithms(eng.bind_engine_params(ev.raw))[0]
+    results = []
+    for iid in all_ids[-2:]:
+        inst = instances.get(iid)
+        models = load_models(eng, inst, ctx)
+        r = algo.predict(models[0], Query(user="u0", num=4))
+        results.append([(s.item, s.score) for s in r.itemScores])
+    assert [i for i, _ in results[0]] == [i for i, _ in results[1]]
+    np.testing.assert_allclose(
+        [s for _, s in results[0]], [s for _, s in results[1]],
+        rtol=2e-4, atol=2e-4)

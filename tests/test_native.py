@@ -527,6 +527,13 @@ class TestNativeEventIngest:
                 stats = json.loads(r.read())
             assert stats["statusCounts"].get("201", 0) >= 1
             assert stats["eventCounts"].get("rate", 0) >= 1
+            # /metrics reaches the EVENT server (forward_all), not the
+            # frontend's own counters
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                text = r.read().decode()
+            assert "pio_event_requests_total" in text, text[:200]
         finally:
             fe.stop()
 

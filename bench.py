@@ -279,10 +279,19 @@ def tpu_era_bench():
     out = {}
     rng = np.random.default_rng(0)
     bs, n_stage = 8192, 8
+
+    def step_slope(run):
+        """Per-step device time via the slope method (shared by both
+        models): run(n) executes n steps and host-read-barriers."""
+        run(1)
+        t1, t2 = run(2), run(52)
+        return round(bs / max((t2 - t1) / 50, 1e-9), 1)
     # Run-unique value jitter: identical program+inputs would let the
     # tunnel's execution memoization serve cached results and collapse
     # the slope to dispatch noise (same defense as train_bench).
     jit_eps = np.float32((time.time_ns() % 997) * 1e-7)
+    import jax.numpy as _jnp
+    w = _jnp.full((bs,), 1.0 + jit_eps, _jnp.float32)  # shared weights
     try:
         from predictionio_tpu.models.two_tower import (
             TwoTowerConfig, _HashableConfig, _train_step_impl, init_state,
@@ -296,7 +305,6 @@ def tpu_era_bench():
                         jnp.int32)
         it = jnp.asarray(rng.integers(0, cfg.n_items, (n_stage, bs)),
                          jnp.int32)
-        w = jnp.full((bs,), 1.0 + jit_eps, jnp.float32)
         hcfg = _HashableConfig(cfg)
 
         @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -313,10 +321,7 @@ def tpu_era_bench():
             float(jnp.sum(s[0]["user_embed"][0]))
             return time.perf_counter() - t0
 
-        run_tt(1)
-        t1, t2 = run_tt(2), run_tt(52)
-        out["two_tower_examples_per_sec_per_chip"] = round(
-            bs / max((t2 - t1) / 50, 1e-9), 1)
+        out["two_tower_examples_per_sec_per_chip"] = step_slope(run_tt)
     except Exception as e:
         out["two_tower_error"] = f"{type(e).__name__}: {e}"
 
@@ -356,10 +361,7 @@ def tpu_era_bench():
                 jnp.float32))
             return time.perf_counter() - t0
 
-        run_dl(1)
-        t1, t2 = run_dl(2), run_dl(52)
-        out["dlrm_examples_per_sec_per_chip"] = round(
-            bs / max((t2 - t1) / 50, 1e-9), 1)
+        out["dlrm_examples_per_sec_per_chip"] = step_slope(run_dl)
     except Exception as e:
         out["dlrm_error"] = f"{type(e).__name__}: {e}"
     return out

@@ -364,20 +364,38 @@ def cmd_deploy(args) -> int:
     if args.native:
         from predictionio_tpu.native.frontend import NativeFrontend
 
+        import threading as _threading
+
+        stop_event = _threading.Event()
+
+        def engine_fallback(method, path_with_qs, body):
+            # Non-query routes (/reload and friends) keep working behind
+            # the native frontend — the reference's deploy server
+            # supports hot-reload after retrain (SURVEY §3.2).  GET /
+            # and GET /metrics stay C++-answered in deploy mode
+            # (frontend liveness + batching counters).  /stop
+            # must stop the FRONTEND, and not from inside its own
+            # callback thread (pio_frontend_stop joins the batchers):
+            # answer first, signal the main loop to tear down.
+            path = path_with_qs.split("?", 1)[0]
+            if path == "/stop" and method == "POST":
+                stop_event.set()
+                return 200, {"status": "stopping"}
+            return srv.handle(method, path, body)
+
         fe = NativeFrontend(srv.query_batch, host=args.ip, port=args.port,
                             max_batch=args.max_batch,
-                            max_wait_us=args.max_wait_us)
+                            max_wait_us=args.max_wait_us,
+                            fallback=engine_fallback)
         port = fe.start()
         print(f"Native engine frontend on {args.ip}:{port} "
               f"(instance {srv._instance.id}; continuous batching "
               f"≤{args.max_batch}; Ctrl-C to stop)")
         try:
-            import time as _time
-
-            while True:
-                _time.sleep(3600)
+            stop_event.wait()
         except KeyboardInterrupt:
-            fe.stop()
+            pass
+        fe.stop()
         return 0
     srv.start(block=False)
     print(f"Engine Server listening on {args.ip}:{srv.port} "

@@ -576,3 +576,77 @@ class TestNativeEventIngest:
             assert calls and max(calls) > 1
         finally:
             fe.stop()
+
+
+@needs_native
+class TestNativeDeployFallback:
+    def test_status_and_reload_behind_native_frontend(self, pio_home):
+        """pio deploy --native forwards non-query routes to the engine
+        server: "/" status and POST /reload (the reference's hot-reload
+        after retrain) must work through the C++ layer."""
+        import numpy as np
+
+        from predictionio_tpu.controller import EngineVariant, RuntimeContext
+        from predictionio_tpu.data.event import DataMap, Event
+        from predictionio_tpu.data.storage import App, get_storage
+        from predictionio_tpu.native.frontend import NativeFrontend
+        from predictionio_tpu.server import EngineServer
+        from predictionio_tpu.templates.recommendation import engine
+        from predictionio_tpu.workflow.core_workflow import run_train
+
+        storage = get_storage()
+        ctx = RuntimeContext.create(storage=storage)
+        app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+        storage.get_events().init(app_id)
+        rng = np.random.default_rng(0)
+        for u in range(8):
+            for i in range(6):
+                if rng.random() < 0.8:
+                    storage.get_events().insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"u{u}", target_entity_type="item",
+                              target_entity_id=f"i{i}",
+                              properties=DataMap({"rating": 3.0})), app_id)
+        variant = EngineVariant.from_dict({
+            "engineFactory":
+                "predictionio_tpu.templates.recommendation:engine",
+            "datasource": {"params": {"appName": "testapp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 4, "numIterations": 3}}],
+        })
+        eng = engine()
+        run_train(eng, variant, ctx)
+        srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+
+        def fallback(method, path_with_qs, body):
+            return srv.handle(method, path_with_qs.split("?", 1)[0], body)
+
+        fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
+                            max_batch=8, max_wait_us=5000,
+                            fallback=fallback)
+        port = fe.start()
+        try:
+            # "/" stays a C++-level liveness probe in deploy mode
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/",
+                                        timeout=10) as r:
+                alive = json.loads(r.read())
+            assert alive == {"status": "alive", "frontend": "native"}
+            first_instance = srv._instance.id
+            # retrain, then hot-reload through the native layer
+            run_train(eng, variant, ctx)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/reload", b"", method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                reloaded = json.loads(r.read())
+            assert reloaded["status"] == "reloaded"
+            assert reloaded["engineInstanceId"] != first_instance
+            # queries still answered after the swap
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/queries.json",
+                json.dumps({"user": "u1", "num": 2}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                res = json.loads(r.read())
+            assert len(res["itemScores"]) == 2
+        finally:
+            fe.stop()

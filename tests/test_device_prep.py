@@ -174,3 +174,27 @@ class TestPlanShapeLockstep:
         host_plan = _plan_side(jnp.asarray(rows, jnp.int32), 200, cfg,
                                host_rows=rows)
         assert dev_plan == host_plan
+
+    def test_loop_warm_executable_delivered_and_used(self):
+        """The plan-shape pre-warm must deliver a usable executable whose
+        statics match what train_als_prepared resolves — otherwise the
+        cold-start overlap silently degrades to a second compile."""
+        from predictionio_tpu.models.als import (
+            _resolve_loop_statics, prepare_als_inputs, train_als_prepared,
+        )
+
+        rows, cols, vals = _coo(seed=9, n_rows=64, n_cols=48, n=5000,
+                                zipf=1.2)
+        cfg = ALSConfig(rank=8, iterations=2, seed=1, device_prep=True,
+                        split_above=32, max_block_floats=1 << 14)
+        inputs = prepare_als_inputs(rows, cols, vals, 64, 48, cfg)
+        assert inputs.loop_warm is not None
+        warm = inputs.loop_warm.result(timeout=120)
+        assert warm is not None, "pre-warm compile failed"
+        statics, exe = warm
+        live = _resolve_loop_statics(cfg, inputs.user_buckets,
+                                     inputs.item_buckets, inputs.chunk_specs)
+        assert statics == live == inputs.loop_warm_statics
+        # and the train path accepts these inputs end-to-end
+        m = train_als_prepared(inputs, cfg)
+        assert np.isfinite(np.asarray(m.user_factors)).all()

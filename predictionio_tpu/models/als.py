@@ -611,9 +611,13 @@ def _plan_side(rows: jax.Array, n_rows: int, config: ALSConfig,
         # Exact replica of ops.device_prep.degree_histogram: counts over
         # ALL n_rows entities (zero-degree included), degrees clipped at
         # the cap into cap+1 bins, over-cap degrees in entity-id order.
-        # Out-of-range ids are DROPPED like the device scatter-add drops
-        # them (np.bincount would raise on negatives / grow on overflow).
+        # Match the device scatter-add's index semantics exactly: JAX
+        # ``.at[rows].add`` WRAPS negative ids numpy-style (id + n_rows
+        # for -n_rows <= id < 0) and drops ids outside [-n_rows, n_rows).
         host_rows = np.asarray(host_rows)
+        if host_rows.size and host_rows.min() < 0:
+            host_rows = np.where(host_rows < 0, host_rows + n_rows,
+                                 host_rows)
         in_range = (host_rows >= 0) & (host_rows < n_rows)
         if not in_range.all():
             host_rows = host_rows[in_range]
@@ -766,9 +770,18 @@ def _prepare_als_inputs_device(
     if co is None:
         lowered = jax.jit(build_both, static_argnames=("pu", "pi")).lower(
             rows_u, rows_i, vals, pu=build_u, pi=build_i)
-        ex = concurrent.futures.ThreadPoolExecutor(1)
-        pend = ex.submit(_compile_build, lowered)
-        ex.shutdown(wait=False)
+        # Daemon thread + Future (same pattern as _compile_train_loop): a
+        # non-daemon executor worker would block interpreter exit if the
+        # backend compile RPC ever hangs.
+        pend = concurrent.futures.Future()
+
+        def _run_build_compile(lowered=lowered, fut=pend):
+            try:
+                fut.set_result(_compile_build(lowered))
+            except BaseException as e:  # delivered to the waiter
+                fut.set_exception(e)
+
+        threading.Thread(target=_run_build_compile, daemon=True).start()
 
     # Fire the fused-loop compile from plan-derived shapes — its ~75 s
     # cold compile overlaps prep execution and whatever the caller does

@@ -137,7 +137,7 @@ class NativeFrontend:
                 # blocks its C++ worker forever (and stop() then deadlocks
                 # joining it), so a miscounting handler fails safe here.
                 if len(outs) != len(group) or any(
-                        not isinstance(o, tuple) or len(o) != 2
+                        not isinstance(o, tuple) or len(o) not in (2, 3)
                         for o in outs):
                     raise ValueError(
                         f"fallback returned {len(outs)} results for "
@@ -213,16 +213,30 @@ class NativeFrontend:
 
     @staticmethod
     def _encode(res) -> "tuple[int, bytes, bytes]":
-        """(status, payload) → (status, body, content-type).
+        """(status, payload[, content_type]) → (status, body, ctype).
 
         A non-JSON-able payload must not abort the response loop (every
         unanswered Pending hangs its C++ worker), so it degrades to a
-        per-item 500.  Text payloads (/metrics expositions) pass through
-        raw with the python HTTP layer's content type.
+        per-item 500.  A handler that needs a specific content type on
+        the wire (the /metrics Prometheus exposition) returns a 3-tuple;
+        bare string payloads default to plain UTF-8 text.
         """
+        if len(res) == 3:
+            status, payload, ctype = res
+            try:
+                body = (payload.encode() if isinstance(payload, str)
+                        else payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                if isinstance(ctype, str):
+                    ctype = ctype.encode()
+                return status, body, ctype
+            except (TypeError, ValueError, AttributeError):
+                logger.exception("non-serializable 3-tuple response")
+                return (500, b'{"message": "Internal server error."}',
+                        b"application/json; charset=UTF-8")
         status, payload = res
         if isinstance(payload, str):
-            return status, payload.encode(), b"text/plain; version=0.0.4"
+            return status, payload.encode(), b"text/plain; charset=utf-8"
         try:
             return (status, json.dumps(payload).encode(),
                     b"application/json; charset=UTF-8")

@@ -152,7 +152,9 @@ class EventServer:
             return self._handle(method, path, params, body, headers)
         except (EventValidationError, StorageError) as e:
             return 400, {"message": str(e)}
-        except json.JSONDecodeError as e:
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            # bad JSON or a bad-UTF-8 body — a client fault, per-item 400
+            # (NOT bare ValueError: that would mislabel e.g. limit=abc)
             return 400, {"message": f"Invalid JSON: {e}"}
         except Exception:
             logger.exception("Event server internal error")
@@ -340,7 +342,17 @@ class EventServer:
         else:
             outs_named = []
             for b in bodies:
-                status, payload = self.handle(method, path, params, b)
+                # handle() is total today (catches decode errors → 400,
+                # everything else → 500); this guard is belt-and-suspenders
+                # for the answered-every-item invariant — a future handle()
+                # regression must not 500 peers whose inserts already
+                # committed (that invites client-retry duplicates).
+                try:
+                    status, payload = self.handle(method, path, params, b)
+                except Exception:
+                    logger.exception("native fallback item failed")
+                    status, payload = 500, {"message":
+                                            "Internal server error."}
                 name = None
                 if method == "POST" and path == "/events.json" \
                         and status == 201:
@@ -352,6 +364,12 @@ class EventServer:
         dt = (time.perf_counter() - t0) * 1e3 / max(len(bodies), 1)
         for status, _, name in outs_named:
             self.stats.record(status, name, dt)
+        if method == "GET" and path == "/metrics":
+            # Explicit Prometheus exposition content type on the wire —
+            # the native layer would otherwise label the text plain UTF-8.
+            return [(s, p, "text/plain; version=0.0.4")
+                    if isinstance(p, str) else (s, p)
+                    for s, p, _ in outs_named]
         return [(s, p) for s, p, _ in outs_named]
 
     def _ingest_group(self, params, bodies: List[bytes]):
@@ -366,7 +384,7 @@ class EventServer:
         for body in bodies:
             try:
                 items.append(json.loads(body.decode("utf-8")))
-            except json.JSONDecodeError as e:
+            except ValueError as e:  # JSONDecodeError + UnicodeDecodeError
                 items.append(ValueError(f"Invalid JSON: {e}"))
         return self._fold_insert(key_row, channel_id, items)
 

@@ -537,6 +537,30 @@ class TestNativeEventIngest:
         finally:
             fe.stop()
 
+    def test_per_item_error_isolation(self, pio_home):
+        """One malformed body (bad UTF-8 / bad JSON) must not fail its
+        peers in a grouped ingest or the fallback singles loop — the
+        peers' inserts may already be committed, and a run-wide 500
+        invites client-retry duplicates (ADVICE r4, medium)."""
+        srv, storage, app_id, key = self._setup_server(pio_home)
+        good = json.dumps({"event": "view", "entityType": "user",
+                           "entityId": "u1", "targetEntityType": "item",
+                           "targetEntityId": "i1"}).encode()
+        bad_utf8 = b'\xff\xfe{"event": "view"}'
+        bad_json = b"{nope"
+        # grouped path (concurrent same-route singles)
+        outs = srv.native_fallback_batch(
+            "POST", f"/events.json?accessKey={key}",
+            [good, bad_utf8, good, bad_json, good])
+        statuses = [o[0] for o in outs]
+        assert statuses == [201, 400, 201, 400, 201], statuses
+        # singles loop (mixed-route fallback, len==1 groups)
+        outs = srv.native_fallback_batch(
+            "POST", f"/events.json?accessKey={key}", [bad_utf8])
+        assert outs[0][0] == 400, outs
+        stored = list(storage.get_events().find(app_id, None, limit=None))
+        assert len(stored) == 3
+
     def test_concurrent_singles_group_commit(self, pio_home):
         from predictionio_tpu.native.frontend import NativeFrontend
 

@@ -119,7 +119,145 @@ def _barrier_inputs(inputs, t0):
     return time.perf_counter() - t0
 
 
-def train_bench():
+def store_bench():
+    """The event STORE in the north-star loop (VERDICT r4 item 1): 25M
+    synthetic rate events are bulk-ingested into a parquet event store
+    (``Events.insert_columnar`` — the columnar half of ``pio import``),
+    scanned back through the recommendation template's EXACT read path
+    (``RecommendationDataSource.read_training`` → unordered projected
+    ``find_columnar`` → dictionary-encoded COO extraction), verified
+    row-for-row against the source arrays, and the scanned COO feeds the
+    headline train bench — "train + serve end-to-end, no Spark" with the
+    store actually in the loop.  The streamed JSONL ``pio import`` path
+    is rated on a sample (its per-line JSON parse is the known cost; the
+    columnar path exists precisely to skip it)."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+
+    from predictionio_tpu.config import load_config
+    from predictionio_tpu.controller.base import RuntimeContext
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.data.store import EventStore
+    from predictionio_tpu.templates.recommendation.engine import (
+        DataSourceParams, RecommendationDataSource,
+    )
+
+    users, items, ratings = synth_ml25m()
+    home = tempfile.mkdtemp(prefix="pio_bench_store_")
+    out = {"n_events": int(N_RATINGS)}
+    try:
+        cfg = load_config(env={
+            "PIO_HOME": home,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PARQUET",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEMORY",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEMORY",
+        })
+        storage = Storage(cfg)
+        app_id = storage.get_apps().insert(App(id=None, name="bench"))
+        events = storage.get_events()
+        events.init(app_id)
+
+        # --- streamed JSONL `pio import` path, rated on a sample (into
+        # its own app so the bulk scan below sees exactly the 25M set)
+        sample_app = storage.get_apps().insert(App(id=None, name="benchjl"))
+        events.init(sample_app)
+        sample = min(N_RATINGS, 200_000)
+        jl = os.path.join(home, "events.jsonl")
+        with open(jl, "w") as f:
+            for k in range(sample):
+                f.write(json.dumps({
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{users[k]}", "targetEntityType": "item",
+                    "targetEntityId": f"i{items[k]}",
+                    "properties": {"rating": float(ratings[k])},
+                    "eventTime": "2026-07-01T00:00:00.000Z"}) + "\n")
+        from predictionio_tpu.data.json_support import event_from_json
+
+        t0 = time.perf_counter()
+        chunk = []
+        imported = 0
+        with open(jl) as f:
+            for line in f:
+                chunk.append(event_from_json(json.loads(line)))
+                if len(chunk) >= 50_000:
+                    imported += len(events.insert_batch(
+                        chunk, sample_app, None))
+                    chunk = []
+        if chunk:
+            imported += len(events.insert_batch(chunk, sample_app, None))
+        jsonl_s = time.perf_counter() - t0
+        out["import_jsonl_events_per_sec"] = round(imported / jsonl_s, 1)
+        events.remove(sample_app)
+
+        # --- bulk columnar ingest: ids/properties as dictionary columns
+        # (162k/59k/10 uniques over 25M rows — index width per row)
+        t0 = time.perf_counter()
+
+        def dcol(idx, vals):
+            return pa.DictionaryArray.from_arrays(
+                pa.array(idx, type=pa.int32()), pa.array(vals))
+
+        n = N_RATINGS
+        zeros = np.zeros(n, np.int32)
+        table = pa.table({
+            "event": dcol(zeros, ["rate"]),
+            "entity_type": dcol(zeros, ["user"]),
+            "entity_id": dcol(users.astype(np.int32),
+                              [f"u{i}" for i in range(N_USERS)]),
+            "target_entity_type": dcol(zeros, ["item"]),
+            "target_entity_id": dcol(items.astype(np.int32),
+                                     [f"i{i}" for i in range(N_ITEMS)]),
+            "properties_json": dcol(
+                (ratings * 2).astype(np.int32) - 1,
+                ['{"rating": %.1f}' % (k * 0.5) for k in range(1, 11)]),
+            "event_time_us": pa.array(
+                np.arange(n, dtype=np.int64) + 1_750_000_000_000_000),
+        })
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        assert events.insert_columnar(table, app_id) == n
+        import_s = time.perf_counter() - t0
+        del table
+        out["import_columnar_s"] = round(build_s + import_s, 2)
+        out["import_columnar_events_per_sec"] = round(
+            n / (build_s + import_s), 1)
+
+        # --- scan → COO through the template's real read path
+        ds = RecommendationDataSource(DataSourceParams(appName="bench"))
+        ctx = RuntimeContext(storage=storage,
+                             event_store=EventStore(storage))
+        t0 = time.perf_counter()
+        data = ds.read_training(ctx)
+        scan_s = time.perf_counter() - t0
+        out["scan_to_coo_s"] = round(scan_s, 2)
+        out["scan_to_coo_events_per_sec"] = round(n / scan_s, 1)
+
+        # --- verify the store round-trip bit-for-bit (code → original id)
+        uk = np.empty(len(data.user_index), np.int64)
+        for k, c in data.user_index.items():
+            uk[c] = int(k[1:])
+        ik = np.empty(len(data.item_index), np.int64)
+        for k, c in data.item_index.items():
+            ik[c] = int(k[1:])
+        ok = (len(data.ratings) == n
+              and np.array_equal(uk[data.user_ids], users)
+              and np.array_equal(ik[data.item_ids], items)
+              and np.array_equal(data.ratings, ratings))
+        out["roundtrip_verified"] = bool(ok)
+        if ok:
+            out["coo"] = (data.user_ids, data.item_ids, data.ratings,
+                          len(data.user_index), len(data.item_index))
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
+    return out
+
+
+def train_bench(coo=None):
     import jax
     import jax.numpy as jnp
 
@@ -129,7 +267,13 @@ def train_bench():
     from predictionio_tpu.parallel.mesh import mesh_from_spec
 
     mesh = mesh_from_spec(os.environ.get("PIO_MESH", ""))
-    users, items, ratings = synth_ml25m()
+    if coo is not None:
+        # the store bench's scanned COO: the north-star train runs on
+        # data that went through ingest → store → columnar scan
+        users, items, ratings, n_users, n_items = coo
+    else:
+        users, items, ratings = synth_ml25m()
+        n_users, n_items = N_USERS, N_ITEMS
     # Run-unique jitter defeats any result caching between bench invocations
     # (the remote-TPU tunnel memoizes identical program+input executions);
     # identical shapes, different values.
@@ -150,7 +294,7 @@ def train_bench():
     h2d_s = _barrier_all(du, di, dr, t0)
 
     t0 = time.perf_counter()
-    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh,
+    inputs = prepare_als_inputs(du, di, dr, n_users, n_items, cfg, mesh=mesh,
                                 host_ids=(users, items))
     prep_cold_s = _barrier_inputs(inputs, t0)
 
@@ -168,7 +312,7 @@ def train_bench():
     # retrain cost (measuring it mid-compile added ~20 s of GIL/tunnel
     # contention that no steady-state retrain sees).
     t0 = time.perf_counter()
-    inputs = prepare_als_inputs(du, di, dr, N_USERS, N_ITEMS, cfg, mesh=mesh,
+    inputs = prepare_als_inputs(du, di, dr, n_users, n_items, cfg, mesh=mesh,
                                 host_ids=(users, items))
     prep_s = _barrier_inputs(inputs, t0)
 
@@ -206,7 +350,7 @@ def train_bench():
         "e2e_full_train_s": round(h2d_s + prep_s + t2, 2),
         "n_chips": n_chips,
         "phase_ms": phases,   # per-iteration device-time breakdown
-        "shape": f"{N_USERS}x{N_ITEMS}x{N_RATINGS} rank{RANK}",
+        "shape": f"{n_users}x{n_items}x{N_RATINGS} rank{RANK}",
         "mesh": os.environ.get("PIO_MESH") or None,
     }
 
@@ -567,9 +711,19 @@ def main():
     # transient stall — GC over the train bench's object graph, WAL
     # writeback).  Isolation beats narrating the interference.
     ingest = ingest_bench()
-    train = train_bench()
+    store = store_bench()
+    # The headline train consumes the COO that went ingest → parquet
+    # store → columnar scan (north star: store in the loop); a store
+    # failure falls back to direct synthesis rather than sinking the
+    # headline metric.
+    coo = store.pop("coo", None)
+    train = train_bench(coo=coo)
+    train["from_store"] = coo is not None
     tpu_era = tpu_era_bench()
     serving = serving_bench()
+    if coo is not None and "scan_to_coo_s" in store:
+        store["e2e_scan_prep_train_s"] = round(
+            store["scan_to_coo_s"] + train["e2e_full_train_s"], 2)
     value = train.pop("value")
     # Self-baseline: speedup over round 3's measured per-iteration time at
     # the same shape on the same chip (reproducible, unlike the retired
@@ -583,6 +737,7 @@ def main():
         "vs_baseline": vs,
         "baseline_ref": "r03 per_iter_ms=250.39 @ ML-25M rank64, 1x v5e",
         "train": train,
+        "store": store,
         "tpu_era": tpu_era,
         "serving": serving,
         "ingest": ingest,

@@ -562,24 +562,34 @@ def cmd_dashboard(args) -> int:
 # --------------------------------------------------------------------------
 
 def cmd_import(args) -> int:
+    """Streamed import: parse + insert in bounded chunks so a 25M-event
+    file never materializes as one Python list (reference: FileToEvents;
+    VERDICT r4 item 1a).  Each chunk is one group-committed insert_batch;
+    a parse error aborts before any further chunk commits."""
     from predictionio_tpu.data.json_support import event_from_json
 
+    CHUNK = 50_000
     s = _storage()
-    events = []
+    channel_id = _resolve_channel(s, args.appid, args.channel)
+    ev = s.get_events()
+    ev.init(args.appid, channel_id)
+    total = 0
+    chunk = []
     with open(args.input) as f:
         for line_no, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(event_from_json(json.loads(line)))
+                chunk.append(event_from_json(json.loads(line)))
             except Exception as e:
                 _die(f"{args.input}:{line_no}: {e}")
-    channel_id = _resolve_channel(s, args.appid, args.channel)
-    ev = s.get_events()
-    ev.init(args.appid, channel_id)
-    ids = ev.insert_batch(events, args.appid, channel_id)
-    print(f"Imported {len(ids)} events to app {args.appid}.")
+            if len(chunk) >= CHUNK:
+                total += len(ev.insert_batch(chunk, args.appid, channel_id))
+                chunk = []
+    if chunk:
+        total += len(ev.insert_batch(chunk, args.appid, channel_id))
+    print(f"Imported {total} events to app {args.appid}.")
     return 0
 
 

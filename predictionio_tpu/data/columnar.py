@@ -42,12 +42,39 @@ def _as_array(col: _ColumnLike) -> pa.Array:
 def encode_ids(col: _ColumnLike) -> Tuple[np.ndarray, BiMap]:
     """Id strings → (dense int64 codes, BiMap) without touching Python rows.
 
-    The BiMap is built from the *dictionary* (one entry per unique id), so
-    cost scales with unique entities, not events.
+    The BiMap is built from the *unique ids present*, in first-appearance
+    order (``BiMap.string_int`` semantics), so cost scales with unique
+    entities, not events.  Already-dictionary-encoded input (a parquet
+    training scan) skips the hash pass entirely: the stored indices are
+    re-coded to first-appearance order with two O(events) numpy passes,
+    and dictionary entries no surviving row references (a filtered scan
+    keeps the full file dictionary) are dropped — the BiMap must not
+    invent entities the training data does not contain.
     """
-    d = _as_array(col).dictionary_encode()
-    codes = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
-    keys = d.dictionary.to_pylist()
+    arr = _as_array(col)
+    if arr.null_count:
+        raise ValueError(
+            f"encode_ids: id column contains {arr.null_count} null(s) — "
+            "entity ids must be non-null (filter or fill before encoding)")
+    if not pa.types.is_dictionary(arr.type):
+        arr = arr.dictionary_encode()
+    idx = arr.indices.to_numpy(zero_copy_only=False)
+    n_dict = len(arr.dictionary)
+    sentinel = np.iinfo(np.int64).max
+    first = np.full(n_dict, sentinel, np.int64)
+    np.minimum.at(first, idx, np.arange(len(idx), dtype=np.int64))
+    present = np.flatnonzero(first < sentinel)
+    if len(present) == n_dict and (
+            n_dict < 2 or bool(np.all(first[1:] > first[:-1]))):
+        # fresh dictionary_encode output: already first-appearance order
+        codes = idx.astype(np.int64)
+        keys = arr.dictionary.to_pylist()
+        return codes, BiMap({k: i for i, k in enumerate(keys)})
+    order = present[np.argsort(first[present], kind="stable")]
+    remap = np.full(n_dict, -1, np.int64)
+    remap[order] = np.arange(len(order))
+    codes = remap[idx]
+    keys = arr.dictionary.take(pa.array(order)).to_pylist()
     return codes, BiMap({k: i for i, k in enumerate(keys)})
 
 
@@ -63,6 +90,20 @@ def numeric_property(
     arr = _as_array(col)
     if len(arr) == 0:
         return np.empty(0, dtype=np.float64)
+    if pa.types.is_dictionary(arr.type):
+        # Low-cardinality property bags (ML-25M has ten distinct rating
+        # JSONs across 25M events): run the extraction over the DICTIONARY
+        # (O(unique)), then fan out by index — one numpy take.
+        if len(arr.dictionary) == 0:
+            return np.full(len(arr), default, np.float64)
+        per_value = numeric_property(arr.dictionary, key, default=default)
+        idx = arr.indices.to_numpy(zero_copy_only=False)
+        if arr.null_count:
+            nulls = np.asarray(pc.is_null(arr))
+            out = per_value[np.where(nulls, 0, idx).astype(np.int64)]
+            out[nulls] = default
+            return out
+        return per_value[idx.astype(np.int64)]
     filled = pc.fill_null(arr, "")
     # json.dumps emits numbers bare: "key": -1.5e3, — capture to , } or ].
     pattern = '"' + re.escape(key) + '"\\s*:\\s*(?P<v>-?[0-9][0-9eE+\\-.]*)'
@@ -116,6 +157,17 @@ def bool_property(
     arr = _as_array(col)
     if len(arr) == 0:
         return np.empty(0, dtype=bool)
+    if pa.types.is_dictionary(arr.type):
+        if len(arr.dictionary) == 0:
+            return np.zeros(len(arr), bool)
+        per_value = bool_property(arr.dictionary, key)
+        idx = arr.indices.to_numpy(zero_copy_only=False)
+        if arr.null_count:
+            nulls = np.asarray(pc.is_null(arr))
+            out = per_value[np.where(nulls, 0, idx).astype(np.int64)]
+            out[nulls] = False
+            return out
+        return per_value[idx.astype(np.int64)]
     pattern = '"' + re.escape(key) + '"\\s*:\\s*(true|1(?:\\.0*)?)([,}\\s]|$)'
     return pc.match_substring_regex(
         pc.fill_null(arr, ""), pattern
@@ -128,6 +180,12 @@ def event_mask(
     column: str = "event",
 ) -> np.ndarray:
     """Boolean mask of rows whose event name is in ``names``."""
+    arr = _as_array(table.column(column))
+    if pa.types.is_dictionary(arr.type) and arr.null_count == 0:
+        # O(unique event names) membership + one numpy take
+        vm = pc.is_in(arr.dictionary, value_set=pa.array(list(names)))
+        return vm.to_numpy(zero_copy_only=False)[
+            arr.indices.to_numpy(zero_copy_only=False)]
     return pc.is_in(
-        table.column(column), value_set=pa.array(list(names))
+        arr, value_set=pa.array(list(names))
     ).to_numpy(zero_copy_only=False)

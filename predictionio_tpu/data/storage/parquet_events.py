@@ -103,6 +103,26 @@ class ParquetEvents(base.Events):
             pq.write_table(table, d / f"part-{uuid.uuid4().hex}.parquet")
         return ids
 
+    def insert_columnar(
+        self, table: pa.Table, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        """Bulk columnar ingest: normalize, stamp ids with one Arrow
+        kernel, write ONE part file — no per-event Python object is ever
+        created.  This is the write half of the north-star data path
+        (25M events land at parquet-writer speed, not event-loop speed).
+
+        Dictionary encoding is parquet's default for strings, so
+        low-cardinality columns (entity ids, the ~10 distinct rating
+        property bags of ML-25M) compress to their index width on disk
+        and come back dictionary-encoded on the training scan."""
+        d = self._check_init(app_id, channel_id)
+        table = base.stamp_event_ids(
+            base.normalize_event_table(table),
+            prefix=f"blk{uuid.uuid4().hex[:12]}-")
+        with self._lock:
+            pq.write_table(table, d / f"part-{uuid.uuid4().hex}.parquet")
+        return table.num_rows
+
     def _flush(self, app_id: int, channel_id: Optional[int]) -> None:
         """Write buffered single-event inserts as one part file. Caller holds
         the lock (RLock: safe from both insert and the read paths)."""
@@ -118,50 +138,123 @@ class ParquetEvents(base.Events):
             for app_id, channel_id in list(self._pending):
                 self._flush(app_id, channel_id)
 
-    def _scan(self, d: Path, app_id: int, channel_id: Optional[int]) -> Optional[pa.Table]:
+    # Parquet stores low-cardinality strings dictionary-encoded anyway;
+    # reading them back AS dictionary arrays keeps the training scan at
+    # index width (int32 per row instead of a materialized string) and
+    # hands `data.columnar.encode_ids` its O(unique) fast path.
+    _DICT_COLS = ["event", "entity_type", "entity_id", "target_entity_type",
+                  "target_entity_id", "properties_json", "pr_id"]
+
+    def _scan(self, d: Path, app_id: int, channel_id: Optional[int],
+              columns: Optional[Sequence[str]] = None) -> Optional[pa.Table]:
         """Caller holds the lock; flushes the write buffer first so reads
-        always see every insert."""
+        always see every insert.  ``columns`` projects the read — parquet
+        is columnar, unread columns cost nothing."""
         self._flush(app_id, channel_id)
         parts = sorted(d.glob("part-*.parquet"))
         if not parts:
             return None
-        return pa.concat_tables([pq.read_table(p) for p in parts])
+        read_cols = list(columns) if columns is not None else None
+        tabs = [pq.read_table(p, columns=read_cols,
+                              read_dictionary=self._DICT_COLS)
+                for p in parts]
+        return tabs[0] if len(tabs) == 1 else pa.concat_tables(tabs)
 
     def _filtered(
         self, app_id, channel_id, start_time, until_time, entity_type, entity_id,
         event_names, target_entity_type, target_entity_id,
+        ordered: bool = True, columns: Optional[Sequence[str]] = None,
     ) -> pa.Table:
         d = self._check_init(app_id, channel_id)
+        read_cols = None
+        if columns is not None:
+            # filters need their columns read even when projected away
+            need = set(columns)
+            for col, active in (
+                ("event_time_us", start_time is not None
+                 or until_time is not None),
+                ("creation_time_us", ordered),
+                ("event_time_us", ordered),
+                ("entity_type", entity_type is not None),
+                ("entity_id", entity_id is not None),
+                ("event", event_names is not None),
+                ("target_entity_type", target_entity_type is not None),
+                ("target_entity_id", target_entity_id is not None),
+            ):
+                if active:
+                    need.add(col)
+            read_cols = [f.name for f in EVENT_ARROW_SCHEMA
+                         if f.name in need]
         with self._lock:
-            table = self._scan(d, app_id, channel_id)
+            table = self._scan(d, app_id, channel_id, columns=read_cols)
         if table is None:
-            return EVENT_ARROW_SCHEMA.empty_table()
+            empty = EVENT_ARROW_SCHEMA.empty_table()
+            return empty.select(list(columns)) if columns is not None \
+                else empty
         mask = None
 
         def _and(m, cond):
+            if cond is None:  # condition passes every row
+                return m
             return cond if m is None else pc.and_(m, cond)
+
+        def _value_mask(col, pred):
+            """Row mask from a VALUE-level predicate.  For dictionary
+            columns the predicate runs over the dictionary (O(unique))
+            and fans out by index; ``None`` short-circuits "every row
+            passes" so the common full-scan filter costs O(unique)."""
+            arr = (col.combine_chunks()
+                   if isinstance(col, pa.ChunkedArray) else col)
+            if not pa.types.is_dictionary(arr.type):
+                return pred(arr)
+            import numpy as np
+
+            vm = pred(arr.dictionary).to_numpy(zero_copy_only=False)
+            if arr.null_count == 0 and vm.all():
+                return None
+            idx = arr.indices.to_numpy(zero_copy_only=False)
+            if arr.null_count:
+                nulls = np.asarray(pc.is_null(arr))
+                out = vm[np.where(nulls, 0, idx).astype(np.int64)]
+                out[nulls] = False
+            else:
+                out = vm[idx]
+            return pa.array(out)
 
         if start_time is not None:
             mask = _and(mask, pc.greater_equal(table["event_time_us"], _us(start_time)))
         if until_time is not None:
             mask = _and(mask, pc.less(table["event_time_us"], _us(until_time)))
         if entity_type is not None:
-            mask = _and(mask, pc.equal(table["entity_type"], entity_type))
+            mask = _and(mask, _value_mask(
+                table["entity_type"], lambda a: pc.equal(a, entity_type)))
         if entity_id is not None:
-            mask = _and(mask, pc.equal(table["entity_id"], entity_id))
+            mask = _and(mask, _value_mask(
+                table["entity_id"], lambda a: pc.equal(a, entity_id)))
         if event_names is not None:
-            mask = _and(
-                mask,
-                pc.is_in(table["event"],
-                         value_set=pa.array(list(event_names), type=pa.string())),
-            )
+            vs = pa.array(list(event_names), type=pa.string())
+            mask = _and(mask, _value_mask(
+                table["event"], lambda a: pc.is_in(a, value_set=vs)))
         if target_entity_type is not None:
-            mask = _and(mask, pc.equal(table["target_entity_type"], target_entity_type))
+            mask = _and(mask, _value_mask(
+                table["target_entity_type"],
+                lambda a: pc.equal(a, target_entity_type)))
         if target_entity_id is not None:
-            mask = _and(mask, pc.equal(table["target_entity_id"], target_entity_id))
-        if mask is not None:
+            mask = _and(mask, _value_mask(
+                table["target_entity_id"],
+                lambda a: pc.equal(a, target_entity_id)))
+        if mask is not None and not (
+                mask.null_count == 0 and pc.all(mask).as_py()):
+            # all-true masks (the common full-training scan) skip the
+            # 25M-row copy a filter() would pay; a null in the mask means
+            # "drop" (Arrow filter semantics), so it never skips
             table = table.filter(mask)
-        return table.sort_by([("event_time_us", "ascending"), ("creation_time_us", "ascending")])
+        if ordered:
+            table = table.sort_by([("event_time_us", "ascending"),
+                                   ("creation_time_us", "ascending")])
+        if columns is not None:
+            table = table.select(list(columns))
+        return table
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         d = self._check_init(app_id, channel_id)
@@ -228,8 +321,11 @@ class ParquetEvents(base.Events):
         event_names: Optional[Sequence[str]] = None,
         target_entity_type: Optional[str] = None,
         target_entity_id: Optional[str] = None,
+        ordered: bool = True,
+        columns: Optional[Sequence[str]] = None,
     ) -> pa.Table:
         return self._filtered(
             app_id, channel_id, start_time, until_time, entity_type, entity_id,
             event_names, target_entity_type, target_entity_id,
+            ordered=ordered, columns=columns,
         )

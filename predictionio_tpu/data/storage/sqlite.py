@@ -687,6 +687,15 @@ class SQLiteEvents(_Repo, base.Events):
 
         return gen()
 
+    # Arrow field -> SQL column, in EVENT_ARROW_SCHEMA order
+    _SQL_COL = {
+        "event_id": "id", "event": "event", "entity_type": "entitytype",
+        "entity_id": "entityid", "target_entity_type": "targetentitytype",
+        "target_entity_id": "targetentityid", "properties_json": "properties",
+        "event_time_us": "eventtime", "pr_id": "prid",
+        "creation_time_us": "creationtime",
+    }
+
     def find_columnar(
         self,
         app_id: int,
@@ -699,30 +708,76 @@ class SQLiteEvents(_Repo, base.Events):
         event_names: Optional[Sequence[str]] = None,
         target_entity_type: Optional[str] = None,
         target_entity_id: Optional[str] = None,
+        ordered: bool = True,
+        columns: Optional[Sequence[str]] = None,
     ) -> pa.Table:
-        """Columnar scan straight out of SQL — skips Event materialization."""
+        """Columnar scan straight out of SQL — skips Event materialization.
+
+        Column-major extraction: rows are transposed per fetch chunk with
+        ``zip(*rows)`` (one C call) instead of a Python loop appending to
+        ten lists per row — the loop was the scan ceiling at the ML-25M
+        shape (VERDICT r4 item 1).  ``columns`` narrows the SELECT;
+        ``ordered=False`` drops the ORDER BY (training scans don't need
+        time order and the sort is O(N log N) in sqlite).
+        """
         self._check_init(app_id, channel_id)
         where, params = self._where(
             app_id, channel_id, start_time, until_time, entity_type, entity_id,
             event_names, target_entity_type, target_entity_id,
         )
-        sql = (
-            f"SELECT id, event, entitytype, entityid, targetentitytype, targetentityid, "
-            f"properties, eventtime, prid, creationtime FROM {self._ns}_events "
-            f"WHERE {where} ORDER BY eventtime ASC"
-        )
-        cols = {f.name: [] for f in base.EVENT_ARROW_SCHEMA}
+        fields = [f for f in base.EVENT_ARROW_SCHEMA
+                  if columns is None or f.name in set(columns)]
+        sel = ", ".join(self._SQL_COL[f.name] for f in fields)
+        sql = f"SELECT {sel} FROM {self._ns}_events WHERE {where}"
+        if ordered:
+            sql += " ORDER BY eventtime ASC"
+        batches = []
+        schema = pa.schema(fields)
         with self._lock:
-            _matrows = self._conn.execute(sql, params).fetchall()
-        for r in _matrows:
-            cols["event_id"].append(r[0])
-            cols["event"].append(r[1])
-            cols["entity_type"].append(r[2])
-            cols["entity_id"].append(r[3])
-            cols["target_entity_type"].append(r[4])
-            cols["target_entity_id"].append(r[5])
-            cols["properties_json"].append(r[6])
-            cols["event_time_us"].append(r[7])
-            cols["pr_id"].append(r[8])
-            cols["creation_time_us"].append(r[9])
-        return pa.table(cols, schema=base.EVENT_ARROW_SCHEMA)
+            cur = self._conn.execute(sql, params)
+            while True:
+                rows = cur.fetchmany(262_144)
+                if not rows:
+                    break
+                cols = list(zip(*rows))
+                batches.append(pa.record_batch(
+                    [pa.array(c, type=f.type)
+                     for c, f in zip(cols, fields)], schema=schema))
+        if not batches:
+            return schema.empty_table()
+        table = pa.Table.from_batches(batches, schema=schema)
+        if columns is not None:
+            table = table.select(list(columns))
+        return table
+
+    def insert_columnar(
+        self, table: pa.Table, app_id: int, channel_id: Optional[int] = None
+    ) -> int:
+        """Bulk ingest via one executemany per chunk — no Event objects.
+        sqlite needs Python values either way; ``zip`` over column lists
+        is the cheapest way to produce them."""
+        self._check_init(app_id, channel_id)
+        table = base.stamp_event_ids(
+            base.normalize_event_table(table),
+            prefix=f"blk{uuid.uuid4().hex[:12]}-")
+        sql = (
+            f"INSERT INTO {self._ns}_events (id, appid, channelid, event, "
+            f"entitytype, entityid, targetentitytype, targetentityid, "
+            f"properties, eventtime, prid, creationtime) "
+            f"VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
+        )
+        order = ("event_id", "event", "entity_type", "entity_id",
+                 "target_entity_type", "target_entity_id",
+                 "properties_json", "event_time_us", "pr_id",
+                 "creation_time_us")
+        n = 0
+        with self._lock, self._conn:
+            for start in range(0, table.num_rows, 262_144):
+                chunk = table.slice(start, 262_144)
+                eid, ev, ety, eid2, tety, teid, props, evt, prid, ct = (
+                    chunk.column(name).to_pylist() for name in order)
+                rows = zip(eid, [app_id] * len(eid), [channel_id] * len(eid),
+                           ev, ety, eid2, tety, teid, props, evt, prid, ct)
+                self._conn.executemany(sql, rows)
+                n += len(eid)
+        return n

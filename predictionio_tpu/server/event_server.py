@@ -376,10 +376,11 @@ class EventServer:
         """Decode each body, then the shared validate+group-insert fold."""
         key_row, err = self._auth(params, None)
         if err:
-            return [(err, {"message": "Invalid accessKey."})] * len(bodies)
+            return [(err, {"message": "Invalid accessKey."}, None)] \
+                * len(bodies)
         channel_id, cerr = self._resolve_channel(key_row.app_id, params)
         if cerr:
-            return [(400, {"message": cerr})] * len(bodies)
+            return [(400, {"message": cerr}, None)] * len(bodies)
         items: List[Any] = []
         for body in bodies:
             try:

@@ -90,7 +90,9 @@ class DLRMDataSource(DataSource):
         p: DataSourceParams = self.params
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
-            event_names=list(p.eventNames))
+            event_names=list(p.eventNames),
+            ordered=False,
+            columns=["entity_id", "target_entity_id", "properties_json"])
         from predictionio_tpu.data.columnar import bool_property, encode_ids
 
         if table.num_rows == 0:

@@ -90,7 +90,9 @@ class ECommerceDataSource(DataSource):
         p: DataSourceParams = self.params
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
-            event_names=list(p.eventNames))
+            event_names=list(p.eventNames),
+            ordered=False,
+            columns=["event", "entity_id", "target_entity_id"])
         from predictionio_tpu.data.columnar import encode_ids, event_mask
 
         user_ids, user_index = encode_ids(table.column("entity_id"))

@@ -114,6 +114,12 @@ class RecommendationDataSource(DataSource):
             entity_type="user",
             target_entity_type="item",
             event_names=list(p.eventNames),
+            # Training is order-independent (the reference's RDD scan is
+            # unordered too) and only these four columns feed the COO —
+            # both save seconds at the ML-25M shape.
+            ordered=False,
+            columns=["event", "entity_id", "target_entity_id",
+                     "properties_json"],
         )
         # Columnar end-to-end (VERDICT.md round-1 item 4): dictionary-encode
         # ids and regex-extract the rating — Arrow kernels, no Python loop

@@ -79,7 +79,8 @@ class TwoTowerDataSource(DataSource):
         p: DataSourceParams = self.params
         table = ctx.event_store.find_columnar(
             p.appName, entity_type="user", target_entity_type="item",
-            event_names=list(p.eventNames))
+            event_names=list(p.eventNames),
+            ordered=False, columns=["entity_id", "target_entity_id"])
         from predictionio_tpu.data.columnar import encode_ids
 
         user_ids, user_index = encode_ids(table.column("entity_id"))

@@ -165,3 +165,60 @@ class TestNumericPropertyEdgeCases:
                          '{"ctx": {"rating": 9}, "rating": 2}'])
         out = numeric_property(col, "rating", default=0.0)
         assert out.tolist() == [4.0, 2.0]
+
+
+class TestDictionaryFastPaths:
+    """Dictionary-encoded input (a parquet training scan) must behave
+    exactly like dense strings — including a FILTERED scan whose stored
+    dictionary still lists values no surviving row references."""
+
+    def test_encode_ids_dictionary_matches_dense(self):
+        vals = ["u3", "u1", "u3", "u2", "u1", "u3"]
+        dense_codes, dense_map = encode_ids(pa.array(vals))
+        dict_codes, dict_map = encode_ids(pa.array(vals).dictionary_encode())
+        assert dense_codes.tolist() == dict_codes.tolist()
+        assert dict(dense_map) == dict(dict_map)
+
+    def test_encode_ids_filtered_dictionary_compacts(self):
+        # dictionary has 4 entries; only 2 appear in the indices (as after
+        # a .filter() on a dictionary column) — the BiMap must not invent
+        # the missing entities, and codes must be first-appearance order
+        d = pa.DictionaryArray.from_arrays(
+            pa.array([2, 0, 2, 0], type=pa.int32()),
+            pa.array(["a", "b", "c", "d"]))
+        codes, bimap = encode_ids(d)
+        assert codes.tolist() == [0, 1, 0, 1]
+        assert dict(bimap) == {"c": 0, "a": 1}
+
+    def test_encode_ids_dictionary_not_in_first_appearance_order(self):
+        # all entries present but stored order != first-appearance order
+        d = pa.DictionaryArray.from_arrays(
+            pa.array([1, 0, 1, 0], type=pa.int32()),
+            pa.array(["x", "y"]))
+        codes, bimap = encode_ids(d)
+        assert codes.tolist() == [0, 1, 0, 1]
+        assert dict(bimap) == {"y": 0, "x": 1}
+
+    def test_numeric_property_dictionary_matches_dense(self):
+        raw = ['{"rating": 4.5}', '{"rating": 1.0}', "{}",
+               '{"rating": 4.5}', None]
+        dense = numeric_property(pa.array(raw, type=pa.string()), "rating",
+                                 default=-1.0)
+        asdict = numeric_property(
+            pa.array(raw, type=pa.string()).dictionary_encode(), "rating",
+            default=-1.0)
+        assert dense.tolist() == asdict.tolist()
+
+    def test_bool_property_dictionary_matches_dense(self):
+        raw = ['{"clicked": true}', '{"clicked": false}', "{}", None,
+               '{"clicked": 1}']
+        dense = bool_property(pa.array(raw, type=pa.string()), "clicked")
+        asdict = bool_property(
+            pa.array(raw, type=pa.string()).dictionary_encode(), "clicked")
+        assert dense.tolist() == asdict.tolist()
+
+    def test_encode_ids_rejects_nulls(self):
+        with pytest.raises(ValueError, match="null"):
+            encode_ids(pa.array(["a", None, "b"]))
+        with pytest.raises(ValueError, match="null"):
+            encode_ids(pa.array(["a", None, "b"]).dictionary_encode())

@@ -558,6 +558,15 @@ class TestNativeEventIngest:
         outs = srv.native_fallback_batch(
             "POST", f"/events.json?accessKey={key}", [bad_utf8])
         assert outs[0][0] == 400, outs
+        # bad access key on a GROUPED run: per-item 401s, not a crash
+        outs = srv.native_fallback_batch(
+            "POST", "/events.json?accessKey=WRONG", [good, good])
+        assert [o[0] for o in outs] == [401, 401], outs
+        # bad channel on a grouped run: per-item 400s
+        outs = srv.native_fallback_batch(
+            "POST", f"/events.json?accessKey={key}&channel=nope",
+            [good, good])
+        assert [o[0] for o in outs] == [400, 400], outs
         stored = list(storage.get_events().find(app_id, None, limit=None))
         assert len(stored) == 3
 

@@ -191,6 +191,94 @@ class TestEventsContract:
         props = [json.loads(p) for p in table.column("properties_json").to_pylist()]
         assert [p["r"] for p in props] == [1.0, 2.0]
 
+    def test_find_columnar_unordered_and_projected(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("rate", "u2", "2026-01-02T00:00:00", target="i2", props={"r": 2.0}),
+                _mk("rate", "u1", "2026-01-01T00:00:00", target="i1", props={"r": 1.0}),
+                _mk("view", "u3", "2026-01-03T00:00:00", target="i1"),
+            ],
+            APP,
+        )
+        # projection returns exactly the named columns (in that order)
+        t = ev.find_columnar(APP, event_names=["rate"],
+                             columns=["entity_id", "properties_json"])
+        assert t.column_names == ["entity_id", "properties_json"]
+        assert sorted(t.column("entity_id").to_pylist()) == ["u1", "u2"]
+        # unordered returns the same ROWS, any order
+        t2 = ev.find_columnar(APP, event_names=["rate"], ordered=False,
+                              columns=["entity_id"])
+        assert sorted(t2.column("entity_id").to_pylist()) == ["u1", "u2"]
+        # ordered remains the default and sorts by event time
+        t3 = ev.find_columnar(APP, event_names=["rate"])
+        assert t3.column("entity_id").to_pylist() == ["u1", "u2"]
+
+    def test_insert_columnar(self, events_backend):
+        import pyarrow as pa
+
+        ev = events_backend
+        ev.init(APP)
+        n = ev.insert_columnar(
+            pa.table({
+                "event": ["rate", "rate", "buy"],
+                "entity_type": ["user"] * 3,
+                "entity_id": ["u1", "u2", "u1"],
+                "target_entity_type": ["item"] * 3,
+                "target_entity_id": ["i1", "i2", "i3"],
+                "properties_json": ['{"rating": 4.5}', '{"rating": 3.0}', None],
+                "event_time_us": [1_700_000_000_000_000 + i for i in range(3)],
+            }),
+            APP,
+        )
+        assert n == 3
+        got = list(ev.find(APP))
+        assert len(got) == 3
+        assert sorted(e.event for e in got) == ["buy", "rate", "rate"]
+        rate1 = next(e for e in got if e.entity_id == "u1" and e.event == "rate")
+        assert rate1.properties.get_double("rating") == 4.5
+        assert rate1.event_time is not None
+        # ids are store-assigned, unique, and get() resolves them
+        ids = {e.event_id for e in got}
+        assert len(ids) == 3 and None not in ids
+        some = next(iter(ids))
+        assert ev.get(some, APP) is not None
+        # the bulk rows coexist with row-path inserts on the same scan
+        ev.insert(_mk("rate", "u9", "2026-01-05T00:00:00", target="i9",
+                      props={"rating": 1.0}), APP)
+        t = ev.find_columnar(APP, event_names=["rate"], ordered=False,
+                             columns=["entity_id", "properties_json"])
+        assert sorted(t.column("entity_id").to_pylist()) == ["u1", "u2", "u9"]
+        from predictionio_tpu.data.columnar import numeric_property
+        vals = numeric_property(t, "rating")
+        assert sorted(vals.tolist()) == [1.0, 3.0, 4.5]
+
+    def test_insert_columnar_validates(self, events_backend):
+        import pyarrow as pa
+
+        ev = events_backend
+        ev.init(APP)
+        with pytest.raises(StorageError):
+            ev.insert_columnar(pa.table({"event": ["x"]}), APP)
+        with pytest.raises(StorageError):
+            ev.insert_columnar(
+                pa.table({"event": ["x"], "entity_type": ["u"],
+                          "entity_id": ["1"], "bogus": ["y"]}), APP)
+        # nulls in a required column are rejected per the event contract
+        with pytest.raises(StorageError):
+            ev.insert_columnar(
+                pa.table({"event": ["x", None], "entity_type": ["u", "u"],
+                          "entity_id": ["1", "2"]}), APP)
+        # per-row null event times get the server-clock default
+        n = ev.insert_columnar(
+            pa.table({"event": ["x", "y"], "entity_type": ["u", "u"],
+                      "entity_id": ["1", "2"],
+                      "event_time_us": pa.array([1_700_000_000_000_000,
+                                                 None])}), APP)
+        assert n == 2
+        assert all(e.event_time is not None for e in ev.find(APP))
+
     def test_aggregate_properties(self, events_backend):
         ev = events_backend
         ev.init(APP)

@@ -28,7 +28,8 @@ import pyarrow.compute as pc
 
 from predictionio_tpu.data.event import BiMap
 
-__all__ = ["encode_ids", "numeric_property", "bool_property", "event_mask"]
+__all__ = ["encode_ids", "numeric_property", "bool_property", "event_mask",
+           "dict_take"]
 
 _ColumnLike = Union[pa.Array, pa.ChunkedArray]
 
@@ -37,6 +38,23 @@ def _as_array(col: _ColumnLike) -> pa.Array:
     if isinstance(col, pa.ChunkedArray):
         return col.combine_chunks()
     return col
+
+
+def dict_take(per_value: np.ndarray, arr: pa.Array, default) -> np.ndarray:
+    """Fan a per-DICTIONARY-VALUE result out to per-row via one numpy take.
+
+    The shared core of every dictionary fast path here (and the parquet
+    scan filters): null rows surface as null *indices*, which
+    ``to_numpy`` converts to float NaN — they must be routed to slot 0
+    BEFORE the integer cast and then overwritten with ``default``.
+    """
+    idx = arr.indices.to_numpy(zero_copy_only=False)
+    if arr.null_count:
+        nulls = np.asarray(pc.is_null(arr))
+        out = per_value[np.where(nulls, 0, idx).astype(np.int64)]
+        out[nulls] = default
+        return out
+    return per_value[idx.astype(np.int64)]
 
 
 def encode_ids(col: _ColumnLike) -> Tuple[np.ndarray, BiMap]:
@@ -96,14 +114,8 @@ def numeric_property(
         # (O(unique)), then fan out by index — one numpy take.
         if len(arr.dictionary) == 0:
             return np.full(len(arr), default, np.float64)
-        per_value = numeric_property(arr.dictionary, key, default=default)
-        idx = arr.indices.to_numpy(zero_copy_only=False)
-        if arr.null_count:
-            nulls = np.asarray(pc.is_null(arr))
-            out = per_value[np.where(nulls, 0, idx).astype(np.int64)]
-            out[nulls] = default
-            return out
-        return per_value[idx.astype(np.int64)]
+        return dict_take(numeric_property(arr.dictionary, key,
+                                          default=default), arr, default)
     filled = pc.fill_null(arr, "")
     # json.dumps emits numbers bare: "key": -1.5e3, — capture to , } or ].
     pattern = '"' + re.escape(key) + '"\\s*:\\s*(?P<v>-?[0-9][0-9eE+\\-.]*)'
@@ -160,14 +172,7 @@ def bool_property(
     if pa.types.is_dictionary(arr.type):
         if len(arr.dictionary) == 0:
             return np.zeros(len(arr), bool)
-        per_value = bool_property(arr.dictionary, key)
-        idx = arr.indices.to_numpy(zero_copy_only=False)
-        if arr.null_count:
-            nulls = np.asarray(pc.is_null(arr))
-            out = per_value[np.where(nulls, 0, idx).astype(np.int64)]
-            out[nulls] = False
-            return out
-        return per_value[idx.astype(np.int64)]
+        return dict_take(bool_property(arr.dictionary, key), arr, False)
     pattern = '"' + re.escape(key) + '"\\s*:\\s*(true|1(?:\\.0*)?)([,}\\s]|$)'
     return pc.match_substring_regex(
         pc.fill_null(arr, ""), pattern
@@ -181,11 +186,10 @@ def event_mask(
 ) -> np.ndarray:
     """Boolean mask of rows whose event name is in ``names``."""
     arr = _as_array(table.column(column))
-    if pa.types.is_dictionary(arr.type) and arr.null_count == 0:
+    if pa.types.is_dictionary(arr.type) and len(arr.dictionary):
         # O(unique event names) membership + one numpy take
         vm = pc.is_in(arr.dictionary, value_set=pa.array(list(names)))
-        return vm.to_numpy(zero_copy_only=False)[
-            arr.indices.to_numpy(zero_copy_only=False)]
+        return dict_take(vm.to_numpy(zero_copy_only=False), arr, False)
     return pc.is_in(
         arr, value_set=pa.array(list(names))
     ).to_numpy(zero_copy_only=False)

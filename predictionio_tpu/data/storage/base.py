@@ -560,10 +560,18 @@ def normalize_event_table(table: pa.Table) -> pa.Table:
         elif field.name == "creation_time_us":
             cols.append(pa.array(np.full(n, now_us, np.int64)))
         elif field.name == "event_time_us":
-            # defaults to creation time, whether that column was given
-            ct = (table.column("creation_time_us").cast(pa.int64())
-                  if "creation_time_us" in names
-                  else pa.array(np.full(n, now_us, np.int64)))
+            # defaults to creation time, whether that column was given;
+            # null creation rows take the server clock too (the null must
+            # not leak into event_time_us — sqlite's eventtime is NOT
+            # NULL and readers assume every Event has a time)
+            if "creation_time_us" in names:
+                import pyarrow.compute as pc
+
+                ct = pc.fill_null(
+                    table.column("creation_time_us").cast(pa.int64()),
+                    now_us)
+            else:
+                ct = pa.array(np.full(n, now_us, np.int64))
             cols.append(ct)
         else:
             cols.append(pa.nulls(n, field.type))

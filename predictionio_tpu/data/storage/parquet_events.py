@@ -207,19 +207,16 @@ class ParquetEvents(base.Events):
                    if isinstance(col, pa.ChunkedArray) else col)
             if not pa.types.is_dictionary(arr.type):
                 return pred(arr)
-            import numpy as np
+            if len(arr.dictionary) == 0:  # all-null column: no row matches
+                import numpy as np
+
+                return pa.array(np.zeros(len(arr), bool))
+            from predictionio_tpu.data.columnar import dict_take
 
             vm = pred(arr.dictionary).to_numpy(zero_copy_only=False)
             if arr.null_count == 0 and vm.all():
                 return None
-            idx = arr.indices.to_numpy(zero_copy_only=False)
-            if arr.null_count:
-                nulls = np.asarray(pc.is_null(arr))
-                out = vm[np.where(nulls, 0, idx).astype(np.int64)]
-                out[nulls] = False
-            else:
-                out = vm[idx]
-            return pa.array(out)
+            return pa.array(dict_take(vm, arr, False))
 
         if start_time is not None:
             mask = _and(mask, pc.greater_equal(table["event_time_us"], _us(start_time)))

@@ -109,6 +109,21 @@ class ALSConfig:
     # shards once both matrices exceed ``factor_shard_threshold`` bytes.
     factor_sharding: str = "auto"
     factor_shard_threshold: int = 256 << 20
+    # Windowed per-chunk gather for blocked mode (SURVEY §2.4 row 2 /
+    # §7 "hard parts").  Sharding the PERSISTENT factors (above) still
+    # left each sweep's TRANSIENT gather full-size: every chunk read the
+    # whole other-side factor matrix (~51 GB at 100M users rank 128 —
+    # past HBM).  Windowed mode gathers, per HBM chunk, ONLY the factor
+    # rows that chunk's indices touch: prep computes the sorted unique
+    # window + remaps the chunk indices to window-local, and the sweep
+    # fetches the window from the sharded factors with a masked local
+    # take + psum over the data axis (each row lives in exactly one
+    # shard, so the sum is exact in f32) — transient ∝ chunk working
+    # set (≤ max_block_floats/rank rows), not matrix size.  "auto" =
+    # on whenever the factors are sharded; per-chunk it only engages
+    # when the window is under half the matrix (else the plain gather
+    # is smaller).  True/False force.
+    gather_window: Union[bool, str] = "auto"
 
 
 @dataclasses.dataclass
@@ -328,6 +343,63 @@ def _merged_side_step(
                          jnp.dtype(_resolve_gram_dtype(gram_dtype)), solver)
 
 
+def _window_gather(src: jax.Array, win: jax.Array,
+                   sharding: Optional[NamedSharding]) -> jax.Array:
+    """Fetch factor rows ``win`` from (possibly row-sharded) ``src``.
+
+    Sharded case: masked local take + ``psum`` over the data axis via
+    ``shard_map`` — each requested row lives in exactly ONE shard, so
+    every other shard contributes exact zeros and the f32 sum is
+    bitwise the row value.  The transient this materializes is
+    ``[len(win), K]`` (the chunk's working set); relying on GSPMD's own
+    gather lowering here is exactly what re-materialized the full
+    matrix per sweep in round 4.
+    """
+    if sharding is None:
+        return src[win]
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    mesh = sharding.mesh
+    d = mesh.shape[AXIS_DATA]
+    shard_rows = src.shape[0] // d  # blocked mode pads rows to divide
+
+    def local(src_local, win_rep):
+        lo = jax.lax.axis_index(AXIS_DATA) * shard_rows
+        loc = win_rep - lo
+        ok = (loc >= 0) & (loc < shard_rows)
+        rows = jnp.where(ok[:, None],
+                         src_local[jnp.where(ok, loc, 0)], 0.0)
+        return jax.lax.psum(rows, AXIS_DATA)
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(AXIS_DATA, None), P()),
+                     out_specs=P())(src, win)
+
+
+def _chunk_window(idx: np.ndarray, msk: np.ndarray, n_src: int,
+                  pad_to: int = 64) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Sorted unique src ids a chunk touches + the window-local remap.
+
+    Returns ``None`` when windowing would not shrink the gather (window
+    ≥ half the matrix) — the caller keeps the plain full-matrix path.
+    Padding repeats the LAST (max) id so the array stays sorted for
+    ``searchsorted``; duplicate fetches of one row are harmless.
+    """
+    win = np.unique(idx[msk])
+    if win.size == 0:
+        win = np.zeros(1, idx.dtype)
+    padded = -(-win.size // pad_to) * pad_to
+    if padded >= n_src // 2:
+        return None
+    win = np.pad(win, (0, padded - win.size), mode="edge")
+    local = np.searchsorted(win, idx).astype(np.int32)
+    local[~msk] = 0
+    return win.astype(np.int32), local
+
+
 def _chunk_split_bucket(
     p: Padded, rank: int, max_block_floats: int, pad_rows: int,
 ) -> List[Tuple]:
@@ -379,28 +451,53 @@ def _device_buckets(
     rank: int,
     max_block_floats: int,
     pad_rows: int,
+    window_n_src: Optional[int] = None,
 ) -> List[Tuple]:
     """Transfer padded buckets, splitting any whose gathered [R, L, K]
     block would exceed the HBM budget into fixed-shape row chunks (last
     chunk row-padded with row_id = -1, which the scatter drops).
 
     Returns ``("plain", idx, vals, msk, row_ids)`` or
-    ``("merged", idx, vals, msk, seg_ids, ent_ids)`` tuples.
+    ``("merged", idx, vals, msk, seg_ids, ent_ids)`` tuples.  With
+    ``window_n_src`` (blocked factor-sharded mode), chunks whose src
+    working set is under half the matrix become ``("plain_w", ...,
+    win)`` / ``("merged_w", ..., win)``: indices are window-local and
+    ``win`` (replicated) names the factor rows the sweep must fetch.
     """
     out = []
+
+    def emit(kind, host_arrs, win=None):
+        if mesh is not None:
+            # put_sharded takes the HOST arrays directly — a jnp.asarray
+            # first would waste a full default-device upload (+ download
+            # in a multi-host gang).
+            row = NamedSharding(mesh, P(AXIS_DATA))
+            arrs = [put_sharded(a, mesh, row) for a in host_arrs]
+            if win is not None:
+                arrs.append(put_sharded(win, mesh,
+                                        NamedSharding(mesh, P())))
+        else:
+            arrs = [jnp.asarray(a) for a in host_arrs]
+            if win is not None:
+                arrs.append(jnp.asarray(win))
+        out.append((kind + "_w" if win is not None else kind, *arrs))
+
+    def windowed(kind, idx, msk, rest):
+        if window_n_src is None:
+            return kind, (idx, *rest), None
+        w = _chunk_window(idx, msk, window_n_src)
+        if w is None:
+            return kind, (idx, *rest), None
+        win, local = w
+        return kind, (local, *rest), win
+
     for p in buckets:
         if p.split:
-            for chunk in _chunk_split_bucket(p, rank, max_block_floats,
-                                             pad_rows):
-                if mesh is not None:
-                    # put_sharded takes the HOST arrays directly — a
-                    # jnp.asarray first would waste a full default-device
-                    # upload (+ download in a multi-host gang).
-                    row = NamedSharding(mesh, P(AXIS_DATA))
-                    arrs = [put_sharded(a, mesh, row) for a in chunk]
-                else:
-                    arrs = [jnp.asarray(a) for a in chunk]
-                out.append(("merged", *arrs))
+            for idx, vals, msk, seg, ent in _chunk_split_bucket(
+                    p, rank, max_block_floats, pad_rows):
+                kind, arrs, win = windowed("merged", idx, msk,
+                                           (vals, msk, seg, ent))
+                emit(kind, arrs, win)
             continue
         r, l = p.indices.shape
         rows_max = max(pad_rows, (max_block_floats // max(l * rank, 1))
@@ -420,14 +517,8 @@ def _device_buckets(
                     rid = np.pad(rid, (0, short), constant_values=-1)
                 chunks.append((idx, vals, msk, rid))
         for idx, vals, msk, rid in chunks:
-            if mesh is not None:
-                row = NamedSharding(mesh, P(AXIS_DATA))
-                arrs = tuple(put_sharded(a, mesh, row)
-                             for a in (idx, vals, msk, rid))
-            else:
-                arrs = (jnp.asarray(idx), jnp.asarray(vals),
-                        jnp.asarray(msk), jnp.asarray(rid))
-            out.append(("plain", *arrs))
+            kind, arrs, win = windowed("plain", idx, msk, (vals, msk, rid))
+            emit(kind, arrs, win)
     return out
 
 
@@ -497,8 +588,16 @@ def prepare_als_inputs(
     k = config.rank
     pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
+    sharded = mesh is not None and _shard_factors(config, n_users, n_items)
+    window = config.gather_window
+    if window == "auto":
+        window = sharded
+    elif not isinstance(window, bool):
+        raise ValueError(f"gather_window must be 'auto', True or False "
+                         f"(got {config.gather_window!r})")
+    window = window and sharded  # windows only exist over sharded factors
     if mesh is not None:
-        if _shard_factors(config, n_users, n_items):
+        if sharded:
             # Row-shard the persistent state; rows pad to the axis size
             # (sharded dims must divide).  Padded rows are never gathered
             # (indices < n) nor scattered to (row_ids < n); the final
@@ -518,6 +617,7 @@ def prepare_als_inputs(
                          max_len=config.max_degree, pad_rows_to=pad_rows,
                          split_above=config.split_above),
         mesh, k, config.max_block_floats, pad_rows,
+        window_n_src=n_items if window else None,
     )
     item_buckets = _device_buckets(
         bucket_by_length(item_ids, user_ids, ratings, n_items,
@@ -525,6 +625,7 @@ def prepare_als_inputs(
                          max_len=config.max_degree, pad_rows_to=pad_rows,
                          split_above=config.split_above),
         mesh, k, config.max_block_floats, pad_rows,
+        window_n_src=n_users if window else None,
     )
     return ALSInputs(uf0=uf, itf0=itf, user_buckets=user_buckets,
                      item_buckets=item_buckets, n_users=n_users,
@@ -1078,19 +1179,27 @@ def _train_loop(uf0, itf0, user_buckets, item_buckets, reg, alpha, iterations,
     item_buckets = _expand_chunks(
         item_buckets, chunk_specs[1] if chunk_specs else None)
 
-    def side(buckets, side_kinds, side_pallas, dst, src):
-        # yty hoisted: identical for every bucket of the side.
+    def side(buckets, side_kinds, side_pallas, dst, src, src_sharding):
+        # yty hoisted: identical for every bucket of the side (full-matrix
+        # gram even in windowed mode — GSPMD reduces the sharded rows to
+        # one [K,K], which is the cheap direction).
         yty = gram(src) if implicit else jnp.zeros(
             (src.shape[1], src.shape[1]), jnp.float32)
         for kind, use_pallas, arrs in zip(side_kinds, side_pallas, buckets):
-            if kind == "merged":
+            if kind.endswith("_w"):
+                # windowed chunk: fetch only the factor rows it touches
+                *arrs, win = arrs
+                bsrc = _window_gather(src, win, src_sharding)
+            else:
+                bsrc = src
+            if kind.startswith("merged"):
                 idx, vals, msk, seg, ent = arrs
-                dst = _merged_solve(idx, vals, msk, seg, ent, dst, src, yty,
+                dst = _merged_solve(idx, vals, msk, seg, ent, dst, bsrc, yty,
                                     reg, alpha, implicit, use_pallas, gdt,
                                     solver)
             else:
                 idx, vals, msk, rid = arrs
-                solved = _solve_bucket(idx, vals, msk, src, yty, reg, alpha,
+                solved = _solve_bucket(idx, vals, msk, bsrc, yty, reg, alpha,
                                        implicit, use_pallas, gdt, solver)
                 dst = _scatter_rows(dst, rid, solved)
         return dst
@@ -1100,9 +1209,11 @@ def _train_loop(uf0, itf0, user_buckets, item_buckets, reg, alpha, iterations,
 
     def body(_, carry):
         uf, itf = carry
-        uf = constrain(side(user_buckets, kinds[0], pallas_flags[0], uf, itf),
+        uf = constrain(side(user_buckets, kinds[0], pallas_flags[0], uf, itf,
+                            factor_shardings[1]),
                        factor_shardings[0])
-        itf = constrain(side(item_buckets, kinds[1], pallas_flags[1], itf, uf),
+        itf = constrain(side(item_buckets, kinds[1], pallas_flags[1], itf, uf,
+                             factor_shardings[0]),
                         factor_shardings[1])
         return (uf, itf)
 

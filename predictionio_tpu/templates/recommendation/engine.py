@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -218,6 +218,10 @@ class ALSAlgorithmParams(Params):
     # they exceed the HBM threshold (blocked ALS, SURVEY §2.4 row 2);
     # "replicated"/"sharded" force.  Meshless runs ignore it.
     factorSharding: str = "auto"  # noqa: N815
+    # Blocked runs: "auto" windows each HBM chunk's factor gather to the
+    # rows it touches (transient ∝ working set, not matrix size);
+    # True/False force.  Ignored unless the factors are sharded.
+    gatherWindow: Union[bool, str] = "auto"  # noqa: N815
 
 
 @dataclasses.dataclass
@@ -259,6 +263,7 @@ class ALSAlgorithm(Algorithm):
             max_degree=p.maxDegree,
             seed=p.seed if p.seed is not None else ctx.seed,
             factor_sharding=p.factorSharding,
+            gather_window=p.gatherWindow,
         )
         # `pio train --checkpoint-dir D --checkpoint-every N` (or the
         # PIO_CHECKPOINT_* env pair) makes a killed train resume from the

@@ -152,6 +152,44 @@ def test_blocked_factor_sharded_equivalence():
                                rtol=1e-3, atol=1e-3)
 
 
+def test_blocked_windowed_gather_equivalence():
+    """Windowed blocked mode (VERDICT r4 item 2): per-chunk gathers fetch
+    only the factor rows the chunk touches, via masked local take + psum
+    over the data axis — placement changes, math does not.  The data is
+    built so user-side chunks touch <half the item matrix (windows
+    engage, asserted) while the item side exceeds the threshold and
+    stays on the plain path — both paths in one compiled loop."""
+    from predictionio_tpu.models.als import (
+        prepare_als_inputs, train_als_prepared,
+    )
+
+    rng = np.random.default_rng(11)
+    n_u, n_i, nnz = 96, 400, 1500
+    users = rng.integers(0, n_u, nnz)
+    items = rng.integers(0, 100, nnz)  # only the first 100 of 400 items
+    ratings = rng.uniform(1, 5, nnz).astype(np.float32)
+    mesh = make_mesh({"data": 8})
+    for extra in (dict(), dict(implicit=True, alpha=40.0)):
+        base = dict(rank=4, iterations=3, reg=0.05, seed=9,
+                    bucket_bounds=(16,), **extra)
+        m1 = train_als(users, items, ratings, n_u, n_i, ALSConfig(**base))
+        cfg = ALSConfig(**base, factor_sharding="sharded",
+                        gather_window=True)
+        inputs = prepare_als_inputs(users, items, ratings, n_u, n_i, cfg,
+                                    mesh=mesh)
+        ukinds = [b[0] for b in inputs.user_buckets]
+        ikinds = [b[0] for b in inputs.item_buckets]
+        assert any(k.endswith("_w") for k in ukinds), ukinds
+        assert not any(k.endswith("_w") for k in ikinds), ikinds
+        m2 = train_als_prepared(inputs, cfg)
+        np.testing.assert_allclose(np.asarray(m1.user_factors),
+                                   np.asarray(m2.user_factors),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(m1.item_factors),
+                                   np.asarray(m2.item_factors),
+                                   rtol=1e-3, atol=1e-3)
+
+
 def test_factor_sharding_auto_threshold():
     from predictionio_tpu.models.als import _shard_factors
 

@@ -326,7 +326,9 @@ def cmd_eventserver(args) -> int:
         from predictionio_tpu.native.frontend import NativeFrontend
 
         fe = NativeFrontend(None, host=args.ip, port=args.port,
-                            fallback_batch=srv.native_fallback_batch)
+                            fallback_batch=srv.native_fallback_batch,
+                            plugin_hook=(srv.plugins.header_block
+                                         if srv.plugins else None))
         fe.start()
         print(f"Event Server (native frontend) listening on "
               f"{args.ip}:{fe.port} (Ctrl-C to stop)")
@@ -335,6 +337,7 @@ def cmd_eventserver(args) -> int:
                 _time.sleep(3600)
         except KeyboardInterrupt:
             fe.stop()
+        srv.plugins.stop()
         return 0
     srv.start(block=False)
     print(f"Event Server listening on {args.ip}:{srv.port} "
@@ -386,7 +389,9 @@ def cmd_deploy(args) -> int:
         fe = NativeFrontend(srv.query_batch, host=args.ip, port=args.port,
                             max_batch=args.max_batch,
                             max_wait_us=args.max_wait_us,
-                            fallback=engine_fallback)
+                            fallback=engine_fallback,
+                            plugin_hook=(srv.plugins.header_block
+                                         if srv.plugins else None))
         port = fe.start()
         print(f"Native engine frontend on {args.ip}:{port} "
               f"(instance {srv._instance.id}; continuous batching "
@@ -396,6 +401,7 @@ def cmd_deploy(args) -> int:
         except KeyboardInterrupt:
             pass
         fe.stop()
+        srv.plugins.stop()
         return 0
     srv.start(block=False)
     print(f"Engine Server listening on {args.ip}:{srv.port} "

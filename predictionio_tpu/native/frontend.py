@@ -14,6 +14,7 @@ import dataclasses
 import json
 import logging
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 from predictionio_tpu.native.build import load_library
@@ -46,7 +47,9 @@ class NativeFrontend:
                  fallback: Optional[Callable[[str, str, bytes],
                                              Any]] = None,
                  fallback_batch: Optional[Callable[[str, str, List[bytes]],
-                                                   List[Any]]] = None):
+                                                   List[Any]]] = None,
+                 plugin_hook: Optional[Callable[[str, int, float],
+                                                str]] = None):
         lib = load_library("serving_frontend")
         if lib is None:
             raise RuntimeError("native frontend unavailable (g++ build failed)")
@@ -63,10 +66,19 @@ class NativeFrontend:
         lib.pio_batch_respond.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_char_p, ctypes.c_int,
                                           ctypes.c_int, ctypes.c_char_p]
+        lib.pio_batch_respond_ex.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                             ctypes.c_char_p, ctypes.c_int,
+                                             ctypes.c_int, ctypes.c_char_p,
+                                             ctypes.c_char_p]
         self._lib = lib
         self._handler = handler
         self._fallback = fallback
         self._fallback_batch = fallback_batch
+        # Server plugin seam: ``plugin_hook(route, status, ms) -> str``
+        # returns CRLF-joined header lines to inject into the response
+        # (PluginManager.header_block); responses then go through
+        # pio_batch_respond_ex so the C++ writer emits them.
+        self._plugin_hook = plugin_hook
         self._host = host
         self._requested_port = port
         self.port: Optional[int] = None
@@ -82,6 +94,7 @@ class NativeFrontend:
     # -- callback from the C++ batcher thread ------------------------------
 
     def _on_batch(self, batch_handle, n: int) -> None:
+        t0 = time.perf_counter()
         try:
             datas: List[bytes] = []
             routes: List[str] = []
@@ -104,13 +117,13 @@ class NativeFrontend:
                       != "/queries.json"]
             if fb_idx:
                 self._dispatch_mixed(batch_handle, n, datas, routes,
-                                     set(fb_idx))
+                                     set(fb_idx), t0)
                 return
-            self._answer_queries(batch_handle, range(n), datas)
+            self._answer_queries(batch_handle, range(n), datas, t0)
         except Exception:
             logger.exception("native frontend callback error")
 
-    def _dispatch_mixed(self, batch_handle, n, datas, routes, fb_set):
+    def _dispatch_mixed(self, batch_handle, n, datas, routes, fb_set, t0):
         results: List[Any] = [None] * n
         # Consecutive same-route fallback runs batch together (the event
         # server group-commits a run of POST /events.json singles).
@@ -152,15 +165,38 @@ class NativeFrontend:
         for i, res in enumerate(results):
             if res is None:
                 continue
-            status, body, ctype = self._encode(res)
-            self._lib.pio_batch_respond(batch_handle, i, body, len(body),
-                                        status, ctype)
+            self._respond(batch_handle, i, res, routes[i], t0)
         q_idx = [i for i in range(n) if i not in fb_set]
         if q_idx:
             self._answer_queries(batch_handle, q_idx,
-                                 [datas[i] for i in q_idx])
+                                 [datas[i] for i in q_idx], t0)
 
-    def _answer_queries(self, batch_handle, idxs, datas) -> None:
+    def _respond(self, batch_handle, i, res, route: str, t0: float) -> None:
+        """Encode + answer one Pending, injecting plugin headers when the
+        server's plugin hook returns any (pio_batch_respond_ex)."""
+        status, body, ctype = self._encode(res)
+        if self._plugin_hook is not None:
+            try:
+                # "METHOD /path" only — the query string may carry an
+                # accessKey and the python transport doesn't pass it either
+                extra = self._plugin_hook(
+                    route.split("?", 1)[0], status,
+                    (time.perf_counter() - t0) * 1e3)
+            except Exception:
+                logger.exception("plugin hook failed")
+                extra = ""
+            if extra:
+                self._lib.pio_batch_respond_ex(
+                    batch_handle, i, body, len(body), status, ctype,
+                    extra.encode())
+                return
+        self._lib.pio_batch_respond(batch_handle, i, body, len(body),
+                                    status, ctype)
+
+    def _answer_queries(self, batch_handle, idxs, datas,
+                        t0: Optional[float] = None) -> None:
+        if t0 is None:
+            t0 = time.perf_counter()
         idxs = list(idxs)
         try:
             raw: List[Optional[dict]] = []
@@ -205,9 +241,8 @@ class NativeFrontend:
                 if raw[k] is None:
                     results[k] = (400, {"message": "Invalid JSON."})
             for k, res in enumerate(results):
-                status, body, ctype = self._encode(res)
-                self._lib.pio_batch_respond(batch_handle, idxs[k], body,
-                                            len(body), status, ctype)
+                self._respond(batch_handle, idxs[k], res,
+                              "POST /queries.json", t0)
         except Exception:
             logger.exception("native frontend callback error")
 

@@ -130,7 +130,10 @@ class EngineServer:
         engine_version: str = __version__,
         instance_id: Optional[str] = None,
         mesh_spec: Optional[str] = None,
+        plugins=None,
     ):
+        from predictionio_tpu.server.plugins import PluginManager
+
         self.engine = engine
         self.variant = variant
         self.storage = storage or get_storage()
@@ -150,6 +153,12 @@ class EngineServer:
         self._serving = None
         self._loaded_at: Optional[_dt.datetime] = None
         self.reload()
+        # Server plugin seam (reference: EngineServerPlugin, SURVEY §5.1).
+        # Started LAST — after reload() — so plugins see a fully
+        # constructed server with a loaded instance.
+        self.plugins = (plugins if plugins is not None
+                        else PluginManager.from_env("PIO_ENGINESERVER_PLUGINS"))
+        self.plugins.start(self)
 
     # -- model lifecycle ----------------------------------------------------
 
@@ -283,6 +292,7 @@ class EngineServer:
             disable_nagle_algorithm = True
 
             def _dispatch(self, method: str):
+                t0 = time.perf_counter()
                 parsed = urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
@@ -293,9 +303,15 @@ class EngineServer:
                 else:
                     data = json.dumps(payload).encode()
                     ctype = "application/json; charset=UTF-8"
+                extra = server_self.plugins.on_request(
+                    f"{method} {parsed.path}", status,
+                    (time.perf_counter() - t0) * 1e3) \
+                    if server_self.plugins else {}
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -327,3 +343,4 @@ class EngineServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self.plugins.stop()

@@ -96,7 +96,9 @@ class EventServer:
     """Owns the HTTP server; one instance per process (reference: main)."""
 
     def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
-                 port: int = 7070):
+                 port: int = 7070, plugins=None):
+        from predictionio_tpu.server.plugins import PluginManager
+
         self.storage = storage or get_storage()
         self.host = host
         self.port = port
@@ -108,6 +110,13 @@ class EventServer:
         self._auth_ttl = 5.0
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Server plugin seam (reference: EventServerPlugin, SURVEY §5.1):
+        # env-discovered request instrumentation, active on the python
+        # HTTP path AND the native fallback path.  Started LAST so
+        # plugins see a fully constructed server.
+        self.plugins = (plugins if plugins is not None
+                        else PluginManager.from_env("PIO_EVENTSERVER_PLUGINS"))
+        self.plugins.start(self)
 
     # -- request-handling core (transport-independent, used by tests) ------
 
@@ -298,11 +307,16 @@ class EventServer:
                         name = None
                 # Record BEFORE replying: a client reading /stats.json right
                 # after its POST completes must see its own event counted.
-                server_self.stats.record(status, name,
-                                         (time.perf_counter() - t0) * 1e3)
+                ms = (time.perf_counter() - t0) * 1e3
+                server_self.stats.record(status, name, ms)
+                extra = server_self.plugins.on_request(
+                    f"{method} {parsed.path}", status, ms) \
+                    if server_self.plugins else {}
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -448,3 +462,4 @@ class EventServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        self.plugins.stop()

@@ -1,0 +1,32 @@
+"""Test fixture for the server plugin seam (loaded via
+PIO_EVENTSERVER_PLUGINS / PIO_ENGINESERVER_PLUGINS as
+``tests.plugin_fixture:make_plugin``)."""
+
+from predictionio_tpu.server.plugins import ServerPlugin
+
+# module-level so tests can reach the instance the env-driven loader made
+LAST = None
+
+
+class CountingPlugin(ServerPlugin):
+    name = "counting"
+
+    def __init__(self):
+        self.started_with = None
+        self.requests = []
+
+    def start(self, server):
+        self.started_with = server
+
+    def on_request(self, route, status, ms):
+        self.requests.append((route, status, ms))
+        return {"X-Plugin-Count": str(len(self.requests))}
+
+    def stop(self):
+        self.started_with = None
+
+
+def make_plugin():
+    global LAST
+    LAST = CountingPlugin()
+    return LAST

@@ -233,3 +233,80 @@ def test_dc_to_json_matches_asdict_on_wire():
               n=Inner(4), s="z")
     assert json.dumps(_dc_to_json(o), sort_keys=True) == \
         json.dumps(dataclasses.asdict(o), sort_keys=True)
+
+
+class TestServerPluginSeam:
+    """SURVEY §5.1: EngineServerPlugin/EventServerPlugin equivalents —
+    env-discovered request instrumentation invoked per request with
+    (route, status, ms), able to inject response headers, active over
+    the python HTTP transport (native covered in test_native.py)."""
+
+    def test_event_server_plugin_counts_and_injects(self, pio_home,
+                                                    monkeypatch):
+        import urllib.request
+
+        import tests.plugin_fixture as pf
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.data.storage.base import AccessKey, App
+        from predictionio_tpu.server.event_server import EventServer
+
+        monkeypatch.setenv("PIO_EVENTSERVER_PLUGINS",
+                           "tests.plugin_fixture:make_plugin")
+        storage = get_storage()
+        app_id = storage.get_apps().insert(App(id=None, name="plugapp"))
+        storage.get_events().init(app_id)
+        key = storage.get_access_keys().insert(AccessKey.generate(app_id))
+        srv = EventServer(storage, host="127.0.0.1", port=0)
+        plugin = pf.LAST
+        assert plugin is not None and plugin.started_with is srv
+        srv.start(block=False)
+        try:
+            ev = {"event": "rate", "entityType": "user", "entityId": "u1"}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}",
+                data=json.dumps(ev).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 201
+                assert r.headers["X-Plugin-Count"] == "1"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/", timeout=10) as r:
+                assert r.headers["X-Plugin-Count"] == "2"
+            routes = [r[0] for r in plugin.requests]
+            assert routes == ["POST /events.json", "GET /"]
+            assert all(isinstance(r[2], float) for r in plugin.requests)
+        finally:
+            srv.stop()
+        # stop() runs the plugin's shutdown hook (lifecycle contract)
+        assert plugin.started_with is None
+
+    def test_plugin_failure_does_not_break_requests(self, pio_home,
+                                                    monkeypatch):
+        import urllib.request
+
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.server.event_server import EventServer
+        from predictionio_tpu.server.plugins import (
+            PluginManager, ServerPlugin,
+        )
+
+        class Exploding(ServerPlugin):
+            def on_request(self, route, status, ms):
+                raise RuntimeError("boom")
+
+        class Injecting(ServerPlugin):
+            def on_request(self, route, status, ms):
+                # CRLF in values must not smuggle extra headers
+                return {"X-Safe": "a\r\nX-Evil: yes"}
+
+        srv = EventServer(get_storage(), host="127.0.0.1", port=0,
+                          plugins=PluginManager([Exploding(), Injecting()]))
+        srv.start(block=False)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/", timeout=10) as r:
+                assert r.status == 200
+                assert "X-Evil" not in r.headers
+                assert r.headers["X-Safe"] == "a  X-Evil: yes"
+        finally:
+            srv.stop()

@@ -511,6 +511,60 @@ def tpu_era_bench():
     return out
 
 
+def mips_bench():
+    """Serving MIPS at a 1M-item corpus (VERDICT r4 item 6): the host
+    fast path is right at ML-25M's 59k items and wrong at 1M+ — compare
+    host vs device top-k latency per batch size.  Device numbers INCLUDE
+    this harness's remote-TPU tunnel round-trip (~100 ms/dispatch, which
+    a directly-attached production host does not pay); the crossover the
+    table shows is therefore conservative for the device."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.topk import host_top_k, top_k_scores
+
+    n_items = 1_000_000 if SCALE >= 1.0 else max(65_536, int(1e6 * SCALE))
+    rank, k = 64, 10
+    rng = np.random.default_rng(5)
+    itf_h = (rng.standard_normal((n_items, rank)) / 8).astype(np.float32)
+    uf_h = (rng.standard_normal((64, rank)) / 8).astype(np.float32)
+    out = {"n_items": n_items, "rank": rank, "k": k,
+           "note": "device latency includes the remote-TPU tunnel RTT"}
+
+    def pcts(lats):
+        lats = sorted(lats)
+        return (round(lats[len(lats) // 2] * 1e3, 2),
+                round(lats[min(len(lats) - 1, int(0.99 * len(lats)))] * 1e3,
+                      2))
+
+    try:
+        for b, reps in ((1, 20), (8, 10), (64, 3)):
+            lats = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                host_top_k(uf_h[:b], itf_h, k)
+                lats.append(time.perf_counter() - t0)
+            p50, p99 = pcts(lats)
+            out[f"host_b{b}_p50_ms"] = p50
+            out[f"host_b{b}_p99_ms"] = p99
+        itf_d = jnp.asarray(itf_h)
+        float(jnp.sum(itf_d[0]))  # upload barrier (not billed per query)
+        for b, reps in ((1, 20), (8, 10), (64, 10)):
+            q = jnp.asarray(uf_h[:b])
+            jax.device_get(top_k_scores(q, itf_d, k))  # compile warm
+            lats = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.device_get(top_k_scores(q, itf_d, k))
+                lats.append(time.perf_counter() - t0)
+            p50, p99 = pcts(lats)
+            out[f"device_b{b}_p50_ms"] = p50
+            out[f"device_b{b}_p99_ms"] = p99
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def serving_bench():
     """BASELINE.md metrics 2-3, recorded into the round artifact."""
     try:
@@ -721,6 +775,7 @@ def main():
     train["from_store"] = coo is not None
     tpu_era = tpu_era_bench()
     serving = serving_bench()
+    serving["mips_1m"] = mips_bench()
     if coo is not None and "scan_to_coo_s" in store:
         store["e2e_scan_prep_train_s"] = round(
             store["scan_to_coo_s"] + train["e2e_full_train_s"], 2)

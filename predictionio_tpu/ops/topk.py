@@ -96,12 +96,15 @@ def sharded_top_k(
     queries: jax.Array,   # [B, K] replicated
     items: jax.Array,     # [N, K] sharded on `axis` along dim 0
     k: int,
+    n_valid: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Top-k over item factors row-sharded on a mesh axis.
 
     Each shard scores its N/shards slice and takes a local top-k; the
     k·shards candidates are all-gathered (tiny) and reduced — the ICI
-    traffic is O(k·shards·B), never O(N·B).
+    traffic is O(k·shards·B), never O(N·B).  ``n_valid`` masks the
+    mesh-padding rows a blocked model carries at the tail (they are
+    zero vectors and would outrank genuinely negative scores).
     """
     n = items.shape[0]
     n_shards = mesh.shape[axis]
@@ -109,8 +112,13 @@ def sharded_top_k(
     per = n // n_shards
 
     def local(q, it):  # it: [N/shards, K]
-        s, i = top_k_scores(q, it, min(k, per))
         shard = jax.lax.axis_index(axis)
+        excl = None
+        if n_valid is not None and n_valid < n:
+            gid = shard * per + jnp.arange(per, dtype=jnp.int32)
+            excl = jnp.broadcast_to(gid[None, :] >= n_valid,
+                                    (q.shape[0], per))
+        s, i = top_k_scores(q, it, min(k, per), exclude=excl)
         i = i + shard * per
         # Gather every shard's candidates, then reduce to the global top-k.
         all_s = jax.lax.all_gather(s, axis, axis=1).reshape(q.shape[0], -1)

@@ -236,12 +236,52 @@ class ALSModelWrapper:
     # dispatch round-trip — so small batches are answered in numpy from
     # these (pulled once, lazily).  None until first host predict.
     _host: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    # (padded item factors, padding-mask bias) for the chunked MIPS path
+    # (built once, reused across requests).  None until first chunked
+    # predict.
+    _chunk_padded: Optional[Tuple[jax.Array, jax.Array]] = None
 
     def host_factors(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._host is None:
-            self._host = jax.device_get(
+            uf, itf = jax.device_get(
                 (self.model.user_factors, self.model.item_factors))
+            # a post_load re-shard pads rows to the mesh size; the host
+            # copies keep the true extents
+            self._host = (uf[:len(self.user_index)],
+                          itf[:len(self.item_index)])
         return self._host
+
+    def post_load(self, ctx) -> None:
+        """Serving-time re-parallelization (reference: SURVEY §3.2, P
+        models re-parallelize in CreateServer): with a serving mesh and
+        a corpus above ``PIO_SERVE_SHARD_ABOVE`` items, row-shard the
+        reloaded factors over the ``data`` axis so predict routes
+        through ``ops.topk.sharded_top_k`` — per-chip memory and score
+        work scale 1/n_chips for corpora that outgrow one chip."""
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is None:
+            return
+        from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
+        if AXIS_DATA not in mesh.shape:
+            return
+        above = int(os.environ.get("PIO_SERVE_SHARD_ABOVE", 1_000_000))
+        itf = self.model.item_factors
+        if itf.shape[0] <= above:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        d = mesh.shape[AXIS_DATA]
+        pad = (-itf.shape[0]) % d
+        # pad HOST-side: a jnp.pad would stage the full corpus on one
+        # device first — OOM at exactly the scale this hook targets;
+        # put_sharded device_puts the numpy array shard-by-shard
+        itf_h = np.pad(np.asarray(jax.device_get(itf)), ((0, pad), (0, 0)))
+        self.model.item_factors = put_sharded(
+            itf_h, mesh, NamedSharding(mesh, P(AXIS_DATA, None)))
+        # queries gather a handful of user rows per request — replicated
+        self.model.user_factors = put_sharded(
+            np.asarray(jax.device_get(self.model.user_factors)), mesh,
+            NamedSharding(mesh, P()))
 
 
 class ALSAlgorithm(Algorithm):
@@ -288,21 +328,60 @@ class ALSAlgorithm(Algorithm):
         )
 
     def predict(self, model: ALSModelWrapper, query: Query) -> PredictedResult:
-        uidx = model.user_index.get(query.user)
-        if uidx is None:
-            return PredictedResult(itemScores=[])  # unknown user (reference parity)
-        # Host fast path: one matmul row + argpartition beats a device
-        # dispatch round-trip for any single query (see ops.topk.host_top_k).
-        uf, itf = model.host_factors()
-        scores, ids = host_top_k(uf[uidx:uidx + 1], itf,
-                                 min(query.num, len(model.item_index)))
-        inv = model.item_index.inverse
-        return PredictedResult(
-            itemScores=[
-                ItemScore(item=inv[int(i)], score=float(s))
-                for s, i in zip(scores[0], ids[0])
-            ]
-        )
+        # One query = a batch of one: the same host-vs-device routing
+        # (MACs threshold, sharded/chunked device paths) applies, so a
+        # corpus that outgrew the host fast path serves B=1 correctly too.
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def _device_top_k(self, model: ALSModelWrapper, idxs, k: int):
+        """Device MIPS over the item corpus, one dispatch, shape-stable.
+
+        Routing (SURVEY §7 "serving latency"): a model whose item
+        factors are row-sharded on a mesh serves via
+        ``ops.topk.sharded_top_k`` (per-shard scoring, O(k·shards·B)
+        ICI traffic); an unsharded corpus above
+        ``PIO_SERVE_CHUNK_ABOVE`` items scores in ``chunked_top_k``
+        slabs so the [B, N] score block never materializes; small
+        corpora take the plain one-matmul path.  Batch pads to the
+        next power of two so only a handful of XLA programs compile
+        (continuous batching with a compiled batch-size menu).
+        """
+        from jax.sharding import NamedSharding
+
+        from predictionio_tpu.ops.topk import chunked_top_k, sharded_top_k
+
+        b = 1 << (len(idxs) - 1).bit_length()  # next pow2: 1/2/4/8/...
+        uidx = jnp.asarray(list(idxs) + [0] * (b - len(idxs)))
+        itf = model.model.item_factors
+        n_items = len(model.item_index)
+        sh = getattr(itf, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.spec and sh.spec[0] \
+                and itf.shape[0] % sh.mesh.shape[sh.spec[0]] == 0:
+            q = model.model.user_factors[uidx]
+            return sharded_top_k(sh.mesh, sh.spec[0], q, itf, k,
+                                 n_valid=n_items)
+        chunk_above = int(os.environ.get("PIO_SERVE_CHUNK_ABOVE",
+                                         2_000_000))
+        if n_items > chunk_above:
+            from predictionio_tpu.ops.topk import NEG_INF
+
+            chunk = 262_144
+            q = model.model.user_factors[uidx]
+            cached = model._chunk_padded
+            if cached is None or cached[0].shape[0] != \
+                    itf.shape[0] + (-itf.shape[0]) % chunk:
+                pad = (-itf.shape[0]) % chunk
+                itf_p = jnp.pad(itf, ((0, pad), (0, 0))) if pad else itf
+                # padding-row mask built ONCE with the padded factors —
+                # rebuilding the [N] bias per request would upload ~8 MB
+                # on the serving hot path
+                bias = jnp.where(jnp.arange(itf_p.shape[0]) < n_items,
+                                 jnp.float32(0.0), NEG_INF)
+                cached = (itf_p, bias)
+                model._chunk_padded = cached  # reused across requests
+            itf_p, bias = cached
+            return chunked_top_k(q, itf_p, k, chunk=chunk, biases=bias)
+        return als_lib.recommend(model.model, uidx, k)
 
     def batch_predict(self, model: ALSModelWrapper, queries):
         """Vectorized eval/serving path: one batched matmul for all queries.
@@ -324,15 +403,13 @@ class ALSAlgorithm(Algorithm):
                     next((m for m in k_menu if m >= num), num))
             # Host when the batch matmul is small (one device dispatch
             # round-trip costs more than ~1e8 host MACs); device for big
-            # sweeps (batch eval over the full catalog).
+            # sweeps (batch eval over the full catalog, 1M+ corpora).
             work = len(idxs) * len(model.item_index) * model.model.rank
             if work <= int(os.environ.get("PIO_SERVE_HOST_MACS", 2 * 10**8)):
                 uf, itf = model.host_factors()
                 scores, ids = host_top_k(uf[np.asarray(idxs)], itf, k)
             else:
-                b = 1 << (len(known) - 1).bit_length()  # next pow2
-                uidx = jnp.asarray(idxs + [0] * (b - len(idxs)))
-                scores, ids = als_lib.recommend(model.model, uidx, k)
+                scores, ids = self._device_top_k(model, idxs, k)
                 # ONE host transfer for the whole batch — per-row
                 # np.asarray would round-trip the device per request.
                 scores, ids = jax.device_get((scores, ids))

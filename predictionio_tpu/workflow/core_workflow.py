@@ -182,6 +182,18 @@ def load_models(
                 cls = getattr(cls, part)
             name_i = list(algo_params)[i] if i < len(algo_params) else None
             models.append(cls.load(f"{instance.id}.{i}", algo_params.get(name_i), ctx))
+    # Post-load re-parallelization hook (reference: SURVEY §3.2 — "P
+    # models may re-parallelize" in CreateServer): a model that wants a
+    # serving-time device layout (e.g. a corpus too large for one chip,
+    # re-sharded over ctx.mesh) reshapes itself here.
+    for m in models:
+        hook = getattr(m, "post_load", None)
+        if callable(hook):
+            try:
+                hook(ctx)
+            except Exception:
+                logger.exception("model post_load hook failed; serving "
+                                 "continues with the loaded layout")
     return models
 
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -240,6 +240,10 @@ class ALSModelWrapper:
     # (built once, reused across requests).  None until first chunked
     # predict.
     _chunk_padded: Optional[Tuple[jax.Array, jax.Array]] = None
+    # jitted device MIPS callables keyed by (kind, batch, k): the hot
+    # path must be ONE cached dispatch — a fresh closure per request
+    # would re-trace and pay several eager round-trips instead.
+    _mips_jit: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
 
     def host_factors(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._host is None:
@@ -357,16 +361,23 @@ class ALSAlgorithm(Algorithm):
         sh = getattr(itf, "sharding", None)
         if isinstance(sh, NamedSharding) and sh.spec and sh.spec[0] \
                 and itf.shape[0] % sh.mesh.shape[sh.spec[0]] == 0:
-            q = model.model.user_factors[uidx]
-            return sharded_top_k(sh.mesh, sh.spec[0], q, itf, k,
-                                 n_valid=n_items)
+            fn = model._mips_jit.get(("sharded", b, k))
+            if fn is None:
+                mesh, axis = sh.mesh, sh.spec[0]
+
+                def _sharded(uf, itf, uidx):
+                    return sharded_top_k(mesh, axis, uf[uidx], itf, k,
+                                         n_valid=n_items)
+
+                fn = jax.jit(_sharded)
+                model._mips_jit[("sharded", b, k)] = fn
+            return fn(model.model.user_factors, itf, uidx)
         chunk_above = int(os.environ.get("PIO_SERVE_CHUNK_ABOVE",
                                          2_000_000))
         if n_items > chunk_above:
             from predictionio_tpu.ops.topk import NEG_INF
 
             chunk = 262_144
-            q = model.model.user_factors[uidx]
             cached = model._chunk_padded
             if cached is None or cached[0].shape[0] != \
                     itf.shape[0] + (-itf.shape[0]) % chunk:
@@ -379,8 +390,19 @@ class ALSAlgorithm(Algorithm):
                                  jnp.float32(0.0), NEG_INF)
                 cached = (itf_p, bias)
                 model._chunk_padded = cached  # reused across requests
+                # ONE corpus copy on device: the padded array serves every
+                # path from here (host_factors trims by len(item_index))
+                model.model.item_factors = itf_p
             itf_p, bias = cached
-            return chunked_top_k(q, itf_p, k, chunk=chunk, biases=bias)
+            fn = model._mips_jit.get(("chunked", b, k))
+            if fn is None:
+                def _chunked(uf, itf_p, bias, uidx):
+                    return chunked_top_k(uf[uidx], itf_p, k, chunk=chunk,
+                                         biases=bias)
+
+                fn = jax.jit(_chunked)
+                model._mips_jit[("chunked", b, k)] = fn
+            return fn(model.model.user_factors, itf_p, bias, uidx)
         return als_lib.recommend(model.model, uidx, k)
 
     def batch_predict(self, model: ALSModelWrapper, queries):

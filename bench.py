@@ -488,6 +488,58 @@ def phase_profile(inputs, iters=4):
         return {k: round(v / iters, 2) for k, v in phases.items()}
 
 
+def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
+                     run_window, barrier, dev_rate, n_windows=6, window=8):
+    """Shared feeder-in-the-loop measurement (two-tower + DLRM).
+
+    Returns (feeder_examples_per_sec, pipeline_examples_per_sec,
+    gap_pct): the feeder's host production rate over one full epoch,
+    then the overlapped feeder→H2D→step loop — ``stack_window`` turns a
+    list of host batches into device arrays, ``run_window`` dispatches
+    ``window`` fused steps (async) and returns the carried state,
+    ``barrier`` forces completion of the final state."""
+    import tempfile
+
+    from predictionio_tpu.native.feeder import EventFeeder, write_cache
+
+    with tempfile.TemporaryDirectory(prefix=prefix) as td:
+        cache = write_cache(f"{td}/c.piof", **cache_kwargs)
+        fd = EventFeeder(cache, bs, seed=1)
+        try:
+            n_fb, t0 = 0, time.perf_counter()
+            b = next_batch(fd)
+            while b is not None:
+                n_fb += len(b[0])
+                b = next_batch(fd)
+            feeder_rate = round(n_fb / (time.perf_counter() - t0), 1)
+        finally:
+            fd.close()
+
+        fd2 = EventFeeder(cache, bs, seed=2)
+        try:
+            state, done = None, 0
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                batches = []
+                while len(batches) < window:
+                    b = next_batch(fd2)
+                    # epoch wrap (None) and ragged tails are skipped to
+                    # keep the window's shapes static
+                    if b is not None and len(b[0]) == bs:
+                        batches.append(b)
+                # async dispatch: the device chews this window while the
+                # feeder assembles the next one
+                state = run_window(state, stack_window(batches), window)
+                done += window * bs
+            barrier(state)
+            dt = time.perf_counter() - t0
+        finally:
+            fd2.close()
+    pipe = round(done / dt, 1)
+    gap = round(100 * (1 - pipe / dev_rate), 1) if dev_rate else None
+    return feeder_rate, pipe, gap
+
+
 def tpu_era_bench():
     """Two-tower + DLRM device training throughput (BASELINE.json's
     TPU-era configs).  Slope method over device-resident batches: the
@@ -552,58 +604,30 @@ def tpu_era_bench():
         # overlapped feeder→H2D→step loop, which through THIS harness's
         # ~9 MB/s tunnel is transfer-bound — the gap is the tunnel, not
         # the feeder, and pipeline_gap_* makes that attributable.
-        import tempfile
-
-        from predictionio_tpu.native.feeder import EventFeeder, write_cache
-
         n_rows = max(bs * 16, int(800_000 * min(SCALE, 1.0)))
-        with tempfile.TemporaryDirectory(prefix="pio_feed_") as td:
-            cache = write_cache(
-                f"{td}/tt.piof",
-                user_ids=rng.integers(0, cfg.n_users, n_rows),
-                item_ids=rng.integers(0, cfg.n_items, n_rows))
-            fd = EventFeeder(cache, bs, seed=1)
-            n_fb = 0
-            t0 = time.perf_counter()
-            for b in fd.epoch():
-                n_fb += len(b[0])
-            feeder_s = time.perf_counter() - t0
-            out["two_tower_feeder_examples_per_sec"] = round(
-                n_fb / feeder_s, 1)
 
-            def run_tt_pipeline(n_windows, window=8):
-                fd2 = EventFeeder(cache, bs, seed=2)
-                st2 = (st.params, st.opt_state, st.step)
-                t0 = time.perf_counter()
-                done = 0
-                for _ in range(n_windows):
-                    ub, ib = [], []
-                    while len(ub) < window:
-                        b = fd2.next_batch()
-                        if b is None:
-                            continue  # epoch wrap
-                        if len(b[0]) < bs:
-                            continue  # ragged tail: keep shapes static
-                        ub.append(b[0].astype(np.int32))
-                        ib.append(b[1].astype(np.int32))
-                    du = jnp.asarray(np.stack(ub))
-                    di = jnp.asarray(np.stack(ib))
-                    # async dispatch: the device chews this window while
-                    # the feeder assembles the next one
-                    st2 = tt_steps(st2, du, di, w, jnp.int32(window),
-                                   cfg=hcfg)
-                    done += window * bs
-                float(jnp.sum(st2[0]["user_embed"][0]))
-                fd2.close()
-                return time.perf_counter() - t0, done
+        def tt_stack(batches):
+            return (jnp.asarray(np.stack([b[0].astype(np.int32)
+                                          for b in batches])),
+                    jnp.asarray(np.stack([b[1].astype(np.int32)
+                                          for b in batches])))
 
-            dt, done = run_tt_pipeline(6)
-            pipe = round(done / dt, 1)
-            out["two_tower_pipeline_examples_per_sec"] = pipe
-            dev = out["two_tower_examples_per_sec_per_chip"]
-            out["two_tower_pipeline_gap_pct"] = round(
-                100 * (1 - pipe / dev), 1) if dev else None
-            fd.close()
+        def tt_run(state, arrays, window):
+            if state is None:
+                state = (st.params, st.opt_state, st.step)
+            du, di = arrays
+            return tt_steps(state, du, di, w, jnp.int32(window), cfg=hcfg)
+
+        feeder_rate, pipe, gap = _feeder_pipeline(
+            "pio_feed_tt_", bs,
+            dict(user_ids=rng.integers(0, cfg.n_users, n_rows),
+                 item_ids=rng.integers(0, cfg.n_items, n_rows)),
+            lambda fd: fd.next_batch(), tt_stack, tt_run,
+            lambda s: float(jnp.sum(s[0]["user_embed"][0])),
+            out["two_tower_examples_per_sec_per_chip"])
+        out["two_tower_feeder_examples_per_sec"] = feeder_rate
+        out["two_tower_pipeline_examples_per_sec"] = pipe
+        out["two_tower_pipeline_gap_pct"] = gap
     except Exception as e:
         out["two_tower_error"] = f"{type(e).__name__}: {e}"
 
@@ -646,59 +670,37 @@ def tpu_era_bench():
         out["dlrm_examples_per_sec_per_chip"] = step_slope(run_dl)
 
         # -- feeder in the loop, DLRM shape (F categorical + 13 dense)
-        import tempfile
-
-        from predictionio_tpu.native.feeder import EventFeeder, write_cache
-
         n_rows = max(bs * 16, int(800_000 * min(SCALE, 1.0)))
-        with tempfile.TemporaryDirectory(prefix="pio_feed_") as td:
-            cache = write_cache(
-                f"{td}/dl.piof",
-                cats=rng.integers(0, 100_000, (n_rows, F)).astype(np.uint32),
-                values=(rng.random(n_rows) < 0.25).astype(np.float32),
-                extras=rng.standard_normal((n_rows, 13)).astype(np.float32))
-            fd = EventFeeder(cache, bs, seed=1)
-            n_fb = 0
-            t0 = time.perf_counter()
-            for b in fd.epoch_cats():
-                n_fb += len(b[0])
-            feeder_s = time.perf_counter() - t0
-            out["dlrm_feeder_examples_per_sec"] = round(n_fb / feeder_s, 1)
+        off = np.asarray(dcfg.offsets)[None, None, :]
 
-            off = np.asarray(dcfg.offsets)[None, None, :]
+        def dl_stack(batches):
+            return (jnp.asarray(np.stack([b[2] for b in batches])),
+                    jnp.asarray(np.stack([b[0].astype(np.int64)
+                                          for b in batches]) + off,
+                                jnp.int32),
+                    jnp.asarray(np.stack([b[1] for b in batches])))
 
-            def run_dl_pipeline(n_windows, window=8):
-                fd2 = EventFeeder(cache, bs, seed=2)
-                st2 = (dst.params, dst.opt_state, dst.step)
-                t0 = time.perf_counter()
-                done = 0
-                for _ in range(n_windows):
-                    cb, yb, db = [], [], []
-                    while len(cb) < window:
-                        b = fd2.next_batch_cats()
-                        if b is None or len(b[0]) < bs:
-                            continue
-                        cb.append(b[0].astype(np.int64))
-                        yb.append(b[1])
-                        db.append(b[2])
-                    dc = jnp.asarray(np.stack(cb) + off, jnp.int32)
-                    dy = jnp.asarray(np.stack(yb))
-                    dd = jnp.asarray(np.stack(db))
-                    st2 = dl_steps(st2, dd, dc, dy, w, jnp.int32(window),
-                                   key=key)
-                    done += window * bs
-                float(jnp.sum(jax.tree_util.tree_leaves(st2[0])[0]).astype(
-                    jnp.float32))
-                fd2.close()
-                return time.perf_counter() - t0, done
+        def dl_run(state, arrays, window):
+            if state is None:
+                state = (dst.params, dst.opt_state, dst.step)
+            dd, dc, dy = arrays
+            return dl_steps(state, dd, dc, dy, w, jnp.int32(window),
+                            key=key)
 
-            dt, done = run_dl_pipeline(6)
-            pipe = round(done / dt, 1)
-            out["dlrm_pipeline_examples_per_sec"] = pipe
-            dev = out["dlrm_examples_per_sec_per_chip"]
-            out["dlrm_pipeline_gap_pct"] = round(
-                100 * (1 - pipe / dev), 1) if dev else None
-            fd.close()
+        feeder_rate, pipe, gap = _feeder_pipeline(
+            "pio_feed_dl_", bs,
+            dict(cats=rng.integers(0, 100_000,
+                                   (n_rows, F)).astype(np.uint32),
+                 values=(rng.random(n_rows) < 0.25).astype(np.float32),
+                 extras=rng.standard_normal((n_rows, 13)).astype(
+                     np.float32)),
+            lambda fd: fd.next_batch_cats(), dl_stack, dl_run,
+            lambda s: float(jnp.sum(
+                jax.tree_util.tree_leaves(s[0])[0]).astype(jnp.float32)),
+            out["dlrm_examples_per_sec_per_chip"])
+        out["dlrm_feeder_examples_per_sec"] = feeder_rate
+        out["dlrm_pipeline_examples_per_sec"] = pipe
+        out["dlrm_pipeline_gap_pct"] = gap
     except Exception as e:
         out["dlrm_error"] = f"{type(e).__name__}: {e}"
     return out

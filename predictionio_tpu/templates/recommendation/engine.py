@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -245,6 +246,15 @@ class ALSModelWrapper:
     # would re-trace and pay several eager round-trips instead.
     _mips_jit: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
 
+    def __getstate__(self):
+        # serving caches are transient (jitted callables and padded
+        # device copies don't pickle, and a reloaded model rebuilds them)
+        d = self.__dict__.copy()
+        d["_host"] = None
+        d["_chunk_padded"] = None
+        d["_mips_jit"] = {}
+        return d
+
     def host_factors(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._host is None:
             uf, itf = jax.device_get(
@@ -286,6 +296,14 @@ class ALSModelWrapper:
         self.model.user_factors = put_sharded(
             np.asarray(jax.device_get(self.model.user_factors)), mesh,
             NamedSharding(mesh, P()))
+
+
+# Guards cold-path serving cache builds (padded corpus copy, jit
+# compiles): a burst of concurrent first requests on the threaded server
+# must not each materialize its own 512 MB+ padded corpus.  One process-
+# wide lock — builds are rare (first request per layout) and short
+# relative to the HBM spike they prevent.
+_serve_cache_lock = threading.Lock()
 
 
 class ALSAlgorithm(Algorithm):
@@ -363,14 +381,17 @@ class ALSAlgorithm(Algorithm):
                 and itf.shape[0] % sh.mesh.shape[sh.spec[0]] == 0:
             fn = model._mips_jit.get(("sharded", b, k))
             if fn is None:
-                mesh, axis = sh.mesh, sh.spec[0]
+                with _serve_cache_lock:
+                    fn = model._mips_jit.get(("sharded", b, k))
+                    if fn is None:
+                        mesh, axis = sh.mesh, sh.spec[0]
 
-                def _sharded(uf, itf, uidx):
-                    return sharded_top_k(mesh, axis, uf[uidx], itf, k,
-                                         n_valid=n_items)
+                        def _sharded(uf, itf, uidx):
+                            return sharded_top_k(mesh, axis, uf[uidx], itf,
+                                                 k, n_valid=n_items)
 
-                fn = jax.jit(_sharded)
-                model._mips_jit[("sharded", b, k)] = fn
+                        fn = jax.jit(_sharded)
+                        model._mips_jit[("sharded", b, k)] = fn
             return fn(model.model.user_factors, itf, uidx)
         chunk_above = int(os.environ.get("PIO_SERVE_CHUNK_ABOVE",
                                          2_000_000))
@@ -378,30 +399,40 @@ class ALSAlgorithm(Algorithm):
             from predictionio_tpu.ops.topk import NEG_INF
 
             chunk = 262_144
-            cached = model._chunk_padded
-            if cached is None or cached[0].shape[0] != \
-                    itf.shape[0] + (-itf.shape[0]) % chunk:
-                pad = (-itf.shape[0]) % chunk
-                itf_p = jnp.pad(itf, ((0, pad), (0, 0))) if pad else itf
-                # padding-row mask built ONCE with the padded factors —
-                # rebuilding the [N] bias per request would upload ~8 MB
-                # on the serving hot path
-                bias = jnp.where(jnp.arange(itf_p.shape[0]) < n_items,
-                                 jnp.float32(0.0), NEG_INF)
-                cached = (itf_p, bias)
-                model._chunk_padded = cached  # reused across requests
-                # ONE corpus copy on device: the padded array serves every
-                # path from here (host_factors trims by len(item_index))
-                model.model.item_factors = itf_p
-            itf_p, bias = cached
+
+            def _stale(c):
+                return c is None or c[0].shape[0] != \
+                    itf.shape[0] + (-itf.shape[0]) % chunk
+
+            if _stale(model._chunk_padded):
+                with _serve_cache_lock:
+                    if _stale(model._chunk_padded):
+                        pad = (-itf.shape[0]) % chunk
+                        itf_p = jnp.pad(itf, ((0, pad), (0, 0))) \
+                            if pad else itf
+                        # padding-row mask built ONCE with the padded
+                        # factors — rebuilding the [N] bias per request
+                        # would upload ~8 MB on the serving hot path
+                        bias = jnp.where(
+                            jnp.arange(itf_p.shape[0]) < n_items,
+                            jnp.float32(0.0), NEG_INF)
+                        # ONE corpus copy on device: the padded array
+                        # serves every path from here (host_factors trims
+                        # by len(item_index))
+                        model.model.item_factors = itf_p
+                        model._chunk_padded = (itf_p, bias)
+            itf_p, bias = model._chunk_padded
             fn = model._mips_jit.get(("chunked", b, k))
             if fn is None:
-                def _chunked(uf, itf_p, bias, uidx):
-                    return chunked_top_k(uf[uidx], itf_p, k, chunk=chunk,
-                                         biases=bias)
+                with _serve_cache_lock:
+                    fn = model._mips_jit.get(("chunked", b, k))
+                    if fn is None:
+                        def _chunked(uf, itf_p, bias, uidx):
+                            return chunked_top_k(uf[uidx], itf_p, k,
+                                                 chunk=chunk, biases=bias)
 
-                fn = jax.jit(_chunked)
-                model._mips_jit[("chunked", b, k)] = fn
+                        fn = jax.jit(_chunked)
+                        model._mips_jit[("chunked", b, k)] = fn
             return fn(model.model.user_factors, itf_p, bias, uidx)
         return als_lib.recommend(model.model, uidx, k)
 

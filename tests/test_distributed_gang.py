@@ -92,6 +92,28 @@ buf = process_allgather(bmodel.user_factors, tiled=True)
 np.testing.assert_allclose(np.asarray(buf),
                            np.asarray(ref_model.user_factors),
                            rtol=1e-5, atol=1e-6)
+
+# Windowed blocked ALS across the REAL gang (round 5): per-chunk factor
+# gathers run as masked local takes + psum over the 2-process data axis;
+# shape chosen so user-side windows engage (items touched << n_items).
+from predictionio_tpu.models.als import prepare_als_inputs, train_als_prepared
+
+wn_i = 300
+wi = drng.integers(0, 20, n_r)
+wcfg = ALSConfig(rank=4, iterations=2, seed=0, split_above=64,
+                 bucket_bounds=(16,), factor_sharding="sharded",
+                 gather_window=True)
+winp = prepare_als_inputs(au, wi, ar, n_u, wn_i, wcfg, mesh=mesh)
+assert any(b[0].endswith("_w") for b in winp.user_buckets), \
+    [b[0] for b in winp.user_buckets]
+wmodel = train_als_prepared(winp, wcfg)
+wref = train_als(au, wi, ar, n_u, wn_i,
+                 ALSConfig(rank=4, iterations=2, seed=0, split_above=64,
+                           bucket_bounds=(16,)), mesh=None)
+wuf = process_allgather(wmodel.user_factors, tiled=True)
+np.testing.assert_allclose(np.asarray(wuf)[:n_u],
+                           np.asarray(wref.user_factors),
+                           rtol=1e-4, atol=1e-5)
 print(f"RANK{rank}_OK", flush=True)
 """
 

@@ -567,6 +567,11 @@ def cmd_dashboard(args) -> int:
 # pio import / export
 # --------------------------------------------------------------------------
 
+# Streamed-import commit granularity (module-level so tests can shrink
+# it to exercise the chunk-boundary resume path).
+IMPORT_CHUNK = 50_000
+
+
 def cmd_import(args) -> int:
     """Streamed import: parse + insert in bounded chunks so a 25M-event
     file never materializes as one Python list (reference: FileToEvents;
@@ -578,7 +583,6 @@ def cmd_import(args) -> int:
     skips the already-imported prefix on retry."""
     from predictionio_tpu.data.json_support import event_from_json
 
-    CHUNK = 50_000
     s = _storage()
     channel_id = _resolve_channel(s, args.appid, args.channel)
     ev = s.get_events()
@@ -603,7 +607,7 @@ def cmd_import(args) -> int:
                     f"were already imported and remain stored; fix the "
                     f"line and re-run with --from-line "
                     f"{last_committed_line + 1} to avoid duplicates.")
-            if len(chunk) >= CHUNK:
+            if len(chunk) >= IMPORT_CHUNK:
                 total += len(ev.insert_batch(chunk, args.appid, channel_id))
                 chunk = []
                 last_committed_line = line_no

@@ -61,6 +61,41 @@ def test_import_export_roundtrip(clean_storage, capsys, tmp_path):
     assert lines[0]["properties"]["rating"] == 0.0
 
 
+def test_import_resume_from_line(clean_storage, capsys, tmp_path,
+                                 monkeypatch):
+    """A parse error mid-file leaves earlier CHUNK-boundary commits in
+    the store and reports the exact resume point; re-running with the
+    reported --from-line imports the rest WITHOUT duplicating the
+    committed prefix."""
+    import re
+
+    from predictionio_tpu.cli import main as cli_main
+
+    monkeypatch.setattr(cli_main, "IMPORT_CHUNK", 2)
+    run(capsys, "app", "new", "resapp")
+    src = tmp_path / "events.ndjson"
+    good = [json.dumps({"event": "rate", "entityType": "user",
+                        "entityId": f"u{i}"}) for i in range(5)]
+    # lines 1-2 commit as one chunk; line 3 is malformed
+    src.write_text("\n".join(good[:2] + ["NOT JSON"] + good[2:]))
+    with pytest.raises(SystemExit):
+        run(capsys, "import", "--appid", "1", "--input", str(src))
+    err = capsys.readouterr().err
+    assert "2 event(s) up to line 2 were already imported" in err
+    m = re.search(r"--from-line (\d+)", err)
+    assert m and m.group(1) == "3"
+    # fix the bad line IN PLACE and re-run with the reported resume point
+    src.write_text("\n".join(good[:2] + [good[4]] + good[2:]))
+    code, out = run(capsys, "import", "--appid", "1", "--input",
+                    str(src), "--from-line", m.group(1))
+    assert code == 0 and "Imported 4 events" in out
+    dst = tmp_path / "out.ndjson"
+    run(capsys, "export", "--appid", "1", "--output", str(dst))
+    ids = [json.loads(l)["entityId"] for l in dst.read_text().splitlines()]
+    # 2 committed before the error + 4 on resume, no duplicates of u0/u1
+    assert sorted(ids) == ["u0", "u1", "u2", "u3", "u4", "u4"]
+
+
 def test_train_via_cli(clean_storage, capsys, tmp_path):
     variant = tmp_path / "engine.json"
     variant.write_text(json.dumps({

@@ -760,6 +760,27 @@ def mips_bench():
     return out
 
 
+def _median3_scalar(run):
+    """Median of three runs + (min, max) spread.  Host-side numbers on
+    this shared one-core box swing ±40% run-to-run (BASELINE.md), which
+    made regressions < 1.4x invisible; the median of three tightens the
+    trend line without pretending the noise away (the spread is
+    reported).  One policy for every host-side section — serving wraps
+    it for dict-shaped drives below."""
+    vals = sorted(run() for _ in range(3))
+    return vals[1], (vals[0], vals[2])
+
+
+def _median_of(drives, key="throughput_rps"):
+    """Dict-shaped counterpart of :func:`_median3_scalar`: returns the
+    whole run whose ``key`` is the median, spread annotated."""
+    runs = sorted([drives() for _ in range(3)],
+                  key=lambda r: r.get(key, 0))
+    med = dict(runs[1])
+    med[f"{key}_spread"] = [runs[0].get(key), runs[2].get(key)]
+    return med
+
+
 def serving_bench():
     """BASELINE.md metrics 2-3, recorded into the round artifact."""
     try:
@@ -771,18 +792,25 @@ def serving_bench():
         out = {}
         srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
         srv.start()
-        out["python"] = bench_serving._drive(srv.port, n_users, 32, 4000)
+        out["python"] = _median_of(
+            lambda: bench_serving._drive(srv.port, n_users, 32, 4000))
         srv.stop()
+        fe = None
         try:
             from predictionio_tpu.native.frontend import NativeFrontend
 
             fe = NativeFrontend(srv.query_batch, host="127.0.0.1", port=0,
                                 max_batch=64, max_wait_us=1000)
             fe.start()
-            out["native"] = bench_serving._drive(fe.port, n_users, 32, 4000)
-            fe.stop()
-        except RuntimeError as e:
-            out["native"] = {"error": str(e)}
+            out["native"] = _median_of(
+                lambda: bench_serving._drive(fe.port, n_users, 32, 4000))
+        except Exception as e:
+            # a failed native drive must not discard the (3x as
+            # expensive) python result already measured above
+            out["native"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            if fe is not None and fe.port is not None:
+                fe.stop()  # leaked C++ threads would outlive the bench
         return out
     except Exception as e:  # serving bench must never sink the train bench
         return {"error": f"{type(e).__name__}: {e}"}
@@ -901,24 +929,32 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
                     "targetEntityId": f"i{i % 4999}",
                     "properties": {"rating": 1 + i % 5}}
 
-        post(url, ev(0))  # warm
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(8) as ex:
-            list(ex.map(lambda i: post(url, ev(i)), range(n_single)))
-        single_eps = n_single / (time.perf_counter() - t0)
+        def run_single():
+            post(url, ev(0))  # warm
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                list(ex.map(lambda i: post(url, ev(i)), range(n_single)))
+            return n_single / (time.perf_counter() - t0)
+
+        single_eps, single_spread = _median3_scalar(run_single)
         burl = url.replace("/events.json", "/batch/events.json")
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(4) as ex:
-            list(ex.map(
-                lambda b: post(burl, [ev(b * batch + j)
-                                      for j in range(batch)]),
-                range(n_batch)))
-        batch_eps = n_batch * batch / (time.perf_counter() - t0)
+
+        def run_batch():
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(4) as ex:
+                list(ex.map(
+                    lambda b: post(burl, [ev(b * batch + j)
+                                          for j in range(batch)]),
+                    range(n_batch)))
+            return n_batch * batch / (time.perf_counter() - t0)
+
+        batch_eps, batch_spread = _median3_scalar(run_batch)
         srv.stop()
 
         # Same single-event workload through the C++ frontend
         # (pio eventserver --native): concurrent singles group-commit.
         native_eps = None
+        native_spread = None
         fe = None
         try:
             from predictionio_tpu.native.frontend import NativeFrontend
@@ -931,11 +967,15 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
             def npost(i):
                 raw_post(fe.port, "nconn", url, ev(i))
 
-            npost(0)
-            t0 = time.perf_counter()
-            with concurrent.futures.ThreadPoolExecutor(8) as ex:
-                list(ex.map(npost, range(n_single)))
-            native_eps = round(n_single / (time.perf_counter() - t0), 1)
+            def run_native():
+                npost(0)
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                    list(ex.map(npost, range(n_single)))
+                return n_single / (time.perf_counter() - t0)
+
+            native_eps, native_spread = _median3_scalar(run_native)
+            native_eps = round(native_eps, 1)
         except Exception as e:
             native_eps = f"error: {type(e).__name__}: {e}"
         finally:
@@ -946,9 +986,16 @@ def ingest_bench(n_single=3000, n_batch=400, batch=50):
         else:
             os.environ["PIO_HOME"] = old_home
         reset_storage()
+        def _rr(pair):
+            return [round(v, 1) for v in pair]
+
         return {"single_events_per_sec": round(single_eps, 1),
+                "single_spread": _rr(single_spread),
                 "batch_events_per_sec": round(batch_eps, 1),
-                "native_single_events_per_sec": native_eps}
+                "batch_spread": _rr(batch_spread),
+                "native_single_events_per_sec": native_eps,
+                "native_single_spread": (_rr(native_spread)
+                                         if native_spread else None)}
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
 

@@ -377,10 +377,17 @@ def _padding_stats(inputs):
 
 
 def train_blocked_bench(coo=None):
-    """Blocked (factor-sharded + windowed-gather) ALS per-iteration on a
-    real mesh — even 1 device (VERDICT r4 item 3b): the sharded path had
-    only ever been equivalence-tested on CPU meshes, never TIMED on the
-    chip.  Slope method, same shape as the headline train."""
+    """Blocked (factor-sharded) ALS per-iteration on a real mesh — even
+    1 device (VERDICT r4 item 3b): the sharded path had only ever been
+    equivalence-tested on CPU meshes, never TIMED on the chip.  Slope
+    method, same shape as the headline train.  On a 1-device axis the
+    windowed gather auto-skips (no cross-shard transient to shrink; its
+    second gather level measured ~3% per-iter — 288 vs 280 ms), so
+    ``windowed_chunks`` is 0 here.  The blocked-vs-replicated gap itself
+    (~280 vs ~177 ms) is the sharded-mode machinery: host-path prep
+    layout + GSPMD sharding constraints, the price of a factor state
+    that scales 1/n_chips — windows engage from 2 shards up, where they
+    are the difference between fitting HBM and not (BASELINE.md)."""
     import jax
     import jax.numpy as jnp
 

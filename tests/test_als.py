@@ -339,3 +339,25 @@ def test_fit_bounds_reduces_padding():
     fixed = [16, 64, 256, 1024, 4096]
     assert padded(bounds) <= padded(fixed)
     assert padded(bounds) <= 1.15 * counts.clip(max=4096).sum()
+
+
+def test_gather_window_auto_skips_single_device_axis():
+    """A 1-device data axis has no cross-shard transient — auto windowing
+    must skip (it would only add a second gather level); an explicit
+    gather_window=True still forces it (how tests exercise the path)."""
+    from predictionio_tpu.models.als import prepare_als_inputs
+
+    rng = np.random.default_rng(3)
+    users = rng.integers(0, 64, 800)
+    items = rng.integers(0, 20, 800)  # 20 of 400 items → windows viable
+    ratings = rng.uniform(1, 5, 800).astype(np.float32)
+    mesh1 = make_mesh({"data": 1})
+    base = dict(rank=4, iterations=1, seed=0, bucket_bounds=(16,),
+                factor_sharding="sharded")
+    inp_auto = prepare_als_inputs(users, items, ratings, 64, 400,
+                                  ALSConfig(**base), mesh=mesh1)
+    assert not any(b[0].endswith("_w") for b in inp_auto.user_buckets)
+    inp_forced = prepare_als_inputs(users, items, ratings, 64, 400,
+                                    ALSConfig(**base, gather_window=True),
+                                    mesh=mesh1)
+    assert any(b[0].endswith("_w") for b in inp_forced.user_buckets)

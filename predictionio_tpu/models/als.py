@@ -27,6 +27,7 @@ import collections
 import dataclasses
 import functools
 import logging
+import math
 import threading
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -44,7 +45,7 @@ from predictionio_tpu.ops.pallas_kernels import (
     ridge_solve_gj_pallas,
     ridge_solve_lu_pallas,
 )
-from predictionio_tpu.ops.ragged import Padded, bucket_by_length
+from predictionio_tpu.ops.ragged import LEN_ALIGN, Padded, bucket_by_length
 from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
 
@@ -586,7 +587,14 @@ def prepare_als_inputs(
                                           n_users, n_items, config,
                                           host_ids=host_ids)
     k = config.rank
-    pad_rows = mesh.shape[AXIS_DATA] if mesh is not None else 1
+    # Row counts pad to the lcm of the mesh axis (sharded dims must
+    # divide) and the TPU sublane (LEN_ALIGN): unaligned bucket rows made
+    # XLA pad/relayout every gathered [R, L, K] block in-graph, EVERY
+    # iteration — measured 292 vs 177 ms/iter at the ML-25M shape, ~70 ms
+    # of it pad/misc ops (the device-prep plan has always 8-aligned its
+    # rows; this brings the host/mesh layout into lock-step).
+    d = mesh.shape[AXIS_DATA] if mesh is not None else 1
+    pad_rows = math.lcm(LEN_ALIGN, d)
     uf, itf = _init_factors(n_users, n_items, k, config.seed)
     sharded = mesh is not None and _shard_factors(config, n_users, n_items)
     window = config.gather_window
@@ -595,7 +603,7 @@ def prepare_als_inputs(
         # window only adds a second gather level (measured ~3% per-iter
         # on the real chip: 288 vs 280 ms).  Windows pay off from 2
         # shards up, where they bound the transient (BASELINE.md).
-        window = sharded and mesh.shape.get(AXIS_DATA, 1) > 1
+        window = sharded and d > 1
     elif not isinstance(window, bool):
         raise ValueError(f"gather_window must be 'auto', True or False "
                          f"(got {config.gather_window!r})")
@@ -606,7 +614,6 @@ def prepare_als_inputs(
             # (sharded dims must divide).  Padded rows are never gathered
             # (indices < n) nor scattered to (row_ids < n); the final
             # model slices them off (train_als_prepared).
-            d = mesh.shape[AXIS_DATA]
             uf = jnp.pad(uf, ((0, (-n_users) % d), (0, 0)))
             itf = jnp.pad(itf, ((0, (-n_items) % d), (0, 0)))
             spec = P(AXIS_DATA, None)

@@ -16,8 +16,10 @@ import argparse
 import concurrent.futures
 import json
 import os
+import re
 import tempfile
 import time
+import urllib.request
 
 import numpy as np
 
@@ -153,6 +155,47 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
     }
 
 
+_BUCKET_RE = re.compile(
+    r'^pio_query_latency_ms_bucket\{le="([^"]+)"\} (\d+)$')
+
+
+def _scrape_server_hist(port: int):
+    """Server-side latency percentiles from /metrics (the shared-registry
+    histogram), emitted NEXT TO the client-side numbers so client/server
+    measurement drift is visible in one JSON line.  Bucket-interpolated,
+    so expect quantization vs the client's exact percentiles — a LARGE gap
+    means one side is measuring the wrong thing."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    buckets = []  # (le, cumulative_count)
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line)
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets.append((le, int(m.group(2))))
+    if not buckets or buckets[-1][1] == 0:
+        return {}
+    total = buckets[-1][1]
+
+    def q(p):
+        target = p * total
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in buckets:
+            if cum >= target and cum > prev_cum:
+                if le == float("inf"):
+                    return prev_le
+                frac = (target - prev_cum) / (cum - prev_cum)
+                return prev_le + (le - prev_le) * frac
+            prev_le, prev_cum = le, cum
+        return prev_le
+
+    return {"server_p50_ms": round(q(0.5), 2),
+            "server_p95_ms": round(q(0.95), 2),
+            "server_p99_ms": round(q(0.99), 2),
+            "server_count": total}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -165,6 +208,7 @@ def main():
     srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
     srv.start()
     res = _drive(srv.port, n_users, args.clients, args.requests)
+    res.update(_scrape_server_hist(srv.port))
     srv.stop()
     print(json.dumps({"frontend": "python", **res}))
 

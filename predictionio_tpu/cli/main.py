@@ -73,8 +73,44 @@ def cmd_status(args) -> int:
               f" ({devs[0].device_kind if devs else '-'})")
     except Exception as e:  # TPU tunnel may be down; status should still work
         print(f"devices: unavailable ({e})")
+    _print_metrics_snapshot(getattr(args, "metrics_url", None))
     print("(sanity check OK)")
     return 0
+
+
+def _print_metrics_snapshot(metrics_url: Optional[str]) -> None:
+    """Metrics view for `pio status`: scrape a running server's /metrics
+    when --metrics-url is given, else render this process's registry (the
+    sanity checks above already touched storage, so it is non-empty only
+    if instrumented code ran — say so rather than print nothing)."""
+    if metrics_url:
+        from urllib.request import urlopen
+
+        url = metrics_url.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        try:
+            with urlopen(url, timeout=10) as resp:
+                text = resp.read().decode()
+        except Exception as e:
+            print(f"metrics: cannot scrape {url} ({e})")
+            return
+        print(f"metrics (scraped from {url}):")
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                print(f"  {line}")
+        return
+    from predictionio_tpu.obs import get_registry
+
+    metrics = get_registry().metrics()
+    samples = [line for m in metrics for line in m.render()]
+    if not samples:
+        print("metrics: none recorded in this process "
+              "(use --metrics-url http://HOST:PORT to scrape a server)")
+        return
+    print("metrics (this process):")
+    for line in samples:
+        print(f"  {line}")
 
 
 # --------------------------------------------------------------------------
@@ -653,7 +689,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true")
     sub = p.add_subparsers(dest="verb", required=True)
 
-    sub.add_parser("status", help="storage + device sanity check").set_defaults(fn=cmd_status)
+    st = sub.add_parser("status", help="storage + device sanity check "
+                                       "+ metrics snapshot")
+    st.add_argument("--metrics-url", dest="metrics_url", default=None,
+                    metavar="URL",
+                    help="scrape a running server's /metrics into the "
+                         "status report (e.g. http://127.0.0.1:7070)")
+    st.set_defaults(fn=cmd_status)
 
     app = sub.add_parser("app", help="app management").add_subparsers(
         dest="app_verb", required=True

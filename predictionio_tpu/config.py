@@ -21,7 +21,14 @@ local backends so a fresh checkout works with zero configuration:
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:  # stdlib on 3.11+; gate so 3.10 installs work (TOML files optional)
+    import tomllib
+except ImportError:  # pragma: no cover - interpreter-version dependent
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ImportError:
+        tomllib = None  # type: ignore[assignment]
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Dict, Mapping, Optional
@@ -110,6 +117,11 @@ def _load_toml(path: Path) -> Dict[str, str]:
         type = "parquetlog"
         path = "/data/events"
     """
+    if tomllib is None:
+        raise RuntimeError(
+            f"cannot read {path}: no TOML parser on this interpreter "
+            "(tomllib needs Python 3.11+, or install tomli); "
+            "use PIO_STORAGE_* env configuration instead.")
     with open(path, "rb") as f:
         doc = tomllib.load(f)
     flat: Dict[str, str] = {}

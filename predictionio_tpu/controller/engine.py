@@ -127,12 +127,25 @@ class Engine:
 
     # -- train / eval drive (reference: Engine.train / Engine.eval) --------
     def train(self, ctx: RuntimeContext, engine_params: EngineParams) -> List[Any]:
-        """Run DataSource → Preparator → each Algorithm.train; returns models."""
+        """Run DataSource → Preparator → each Algorithm.train; returns models.
+
+        Each DASE stage is a named observability phase: a span in the
+        enclosing ``run_train`` trace and a ``pio_train_phase_ms`` series.
+        """
+        from predictionio_tpu.obs import phase
+
         datasource = self.datasource_class(engine_params.datasource_params)
         preparator = self.preparator_class(engine_params.preparator_params)
-        td = datasource.read_training(ctx)
-        pd = preparator.prepare(ctx, td)
-        return [algo.train(ctx, pd) for algo in self.make_algorithms(engine_params)]
+        with phase("train.datasource"):
+            td = datasource.read_training(ctx)
+        with phase("train.prepare"):
+            pd = preparator.prepare(ctx, td)
+        models = []
+        names = [n for n, _ in engine_params.algorithms_params]
+        for name, algo in zip(names, self.make_algorithms(engine_params)):
+            with phase("train.algorithm", algo=name):
+                models.append(algo.train(ctx, pd))
+        return models
 
     def eval(
         self, ctx: RuntimeContext, engine_params: EngineParams

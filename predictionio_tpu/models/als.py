@@ -358,10 +358,7 @@ def _window_gather(src: jax.Array, win: jax.Array,
     """
     if sharding is None:
         return src[win]
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from predictionio_tpu.parallel.compat import shard_map
 
     mesh = sharding.mesh
     d = mesh.shape[AXIS_DATA]

@@ -37,6 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import AXIS_EXPERT, put_sharded
 
 __all__ = ["DLRMConfig", "DLRMState", "init_state", "train_step", "train",
@@ -148,7 +149,7 @@ def sharded_embedding_lookup(
         return jax.lax.psum_scatter(part, AXIS_EXPERT, scatter_dimension=0,
                                     tiled=True)            # [B/S, F, E]
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(AXIS_EXPERT, None), P(AXIS_EXPERT, None)),
         out_specs=P(AXIS_EXPERT, None, None),
@@ -314,24 +315,35 @@ def train(
         from predictionio_tpu.native.build import load_library
 
         use_feeder = load_library("feeder") is not None
+    # Pipeline decomposition (ISSUE/BENCH_r05): host_wait vs h2d vs
+    # device wait, via the one-step-lag probe (no lost overlap).
+    from predictionio_tpu.obs import PipelineProbe
+
+    probe = PipelineProbe("dlrm")
     global_step = 0
-    for d, c, y in (feeder_epochs() if use_feeder else numpy_epochs()):
+    for d, c, y in probe.iter_host(
+            feeder_epochs() if use_feeder else numpy_epochs()):
         global_step += 1
         if global_step <= start_step:
             continue  # resume fast-forward: batch already trained
-        pad = bs - len(y)
-        d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
-        c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
-        w = np.concatenate([np.ones(len(y), np.float32),
-                            np.zeros(pad, np.float32)])
-        y = np.concatenate([y, np.zeros(pad, np.float32)])
-        args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
-                jnp.asarray(y, jnp.float32), jnp.asarray(w)]
-        if sh is not None:
-            args = [put_sharded(a, mesh, sh) for a in args]
+        n_real = len(y)
+        with probe.h2d():
+            pad = bs - len(y)
+            d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
+            c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
+            w = np.concatenate([np.ones(len(y), np.float32),
+                                np.zeros(pad, np.float32)])
+            y = np.concatenate([y, np.zeros(pad, np.float32)])
+            args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
+                    jnp.asarray(y, jnp.float32), jnp.asarray(w)]
+            if sh is not None:
+                args = [put_sharded(a, mesh, sh) for a in args]
+        probe.sync()  # wait on step N-1 here: its state feeds step N
         state, _ = train_step(state, *args, cfg, mesh)
+        probe.dispatched(state, examples=n_real)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))
+    probe.finish()
     ckpt.complete()
     ckpt.close()
     return state

@@ -266,23 +266,36 @@ def train(
         from predictionio_tpu.native.build import load_library
 
         use_feeder = load_library("feeder") is not None
+    # Pipeline decomposition (ISSUE/BENCH_r05): host_wait vs h2d vs
+    # device wait, via the one-step-lag probe (no lost overlap).
+    from predictionio_tpu.obs import PipelineProbe
+
+    probe = PipelineProbe("two_tower")
     global_step = 0
-    for u, i, w in (feeder_epochs() if use_feeder else numpy_epochs()):
+    for u, i, w in probe.iter_host(
+            feeder_epochs() if use_feeder else numpy_epochs()):
         global_step += 1
         if global_step <= start_step:
             continue  # resume fast-forward: batch already trained
-        pad = bs - len(u)
-        u = np.concatenate([np.asarray(u, np.int64), np.zeros(pad, np.int64)])
-        i = np.concatenate([np.asarray(i, np.int64), np.zeros(pad, np.int64)])
-        w = np.concatenate([np.asarray(w, np.float32),
-                            np.zeros(pad, np.float32)])
-        args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
-        if batch_sharding is not None:
-            args = tuple(put_sharded(a, mesh, batch_sharding)
-                         for a in args)
+        n_real = len(u)
+        with probe.h2d():
+            pad = bs - len(u)
+            u = np.concatenate([np.asarray(u, np.int64),
+                                np.zeros(pad, np.int64)])
+            i = np.concatenate([np.asarray(i, np.int64),
+                                np.zeros(pad, np.int64)])
+            w = np.concatenate([np.asarray(w, np.float32),
+                                np.zeros(pad, np.float32)])
+            args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
+            if batch_sharding is not None:
+                args = tuple(put_sharded(a, mesh, batch_sharding)
+                             for a in args)
+        probe.sync()  # wait on step N-1 here: its state feeds step N
         state, _ = train_step(state, *args, cfg)
+        probe.dispatched(state, examples=n_real)
         ckpt.maybe_save(global_step,
                         (state.params, state.opt_state, state.step))
+    probe.finish()
     ckpt.complete()
     ckpt.close()
     return state

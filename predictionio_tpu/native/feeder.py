@@ -14,12 +14,14 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import time
 from pathlib import Path
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
 from predictionio_tpu.native.build import load_library
+from predictionio_tpu.obs import get_registry
 
 __all__ = ["write_cache", "EventFeeder"]
 
@@ -115,6 +117,22 @@ class EventFeeder:
         self.batch_size = batch_size
         self.n_extra = int(lib.pio_feeder_n_extra(self._h))
         self.n_cat = int(lib.pio_feeder_n_cat(self._h))
+        # Pipeline observability (ISSUE: decompose the feeder→device gap):
+        # wait time per native assembly call + how much of the epoch is
+        # still queued behind the training loop.
+        reg = get_registry()
+        self._m_wait = reg.histogram(
+            "pio_feeder_wait_ms",
+            "Host wait per native batch-assembly call.")
+        self._m_batches = reg.counter(
+            "pio_feeder_batches_total", "Batches served by the feeder.")
+        self._m_rows = reg.counter(
+            "pio_feeder_rows_total", "Rows served by the feeder.")
+        self._m_depth = reg.gauge(
+            "pio_feeder_queue_depth",
+            "Rows remaining in the feeder's current epoch.")
+        self._epoch_served = 0
+        self._m_depth.set(int(lib.pio_feeder_num_rows(self._h)))
         self._users = np.empty(batch_size, np.uint32)
         self._items = np.empty(batch_size, np.uint32)
         self._cats = np.empty((batch_size, self.n_cat), np.uint32)
@@ -131,13 +149,22 @@ class EventFeeder:
                 if self._extras is not None
                 else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
 
-    def _finish_batch(self, n, lead):
-        """Shared batch tail: error/epoch-boundary handling + copies."""
+    def _finish_batch(self, n, lead, wait_ms: float):
+        """Shared batch tail: error/epoch-boundary handling, metrics,
+        copies."""
         if n < 0:
             raise RuntimeError("feeder error")
+        self._m_wait.observe(wait_ms)
         if n == 0:
+            # Epoch boundary: the whole dataset is queued again.
+            self._epoch_served = 0
+            self._m_depth.set(len(self))
             return None
         n = int(n)
+        self._m_batches.inc()
+        self._m_rows.inc(n)
+        self._epoch_served += n
+        self._m_depth.set(max(len(self) - self._epoch_served, 0))
         out = tuple(a[:n].copy() for a in lead) + (self._vals[:n].copy(),)
         if self._extras is not None:
             out = out + (self._extras[:n].copy(),)
@@ -151,6 +178,7 @@ class EventFeeder:
                 f"cache has {self.n_cat} categorical column(s); the legacy "
                 "(users, items) batch API needs >= 2 — use "
                 "next_batch_cats()/epoch_cats()")
+        t0 = time.perf_counter()
         n = self._lib.pio_feeder_next_batch(
             self._h, self.batch_size,
             self._users.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
@@ -158,18 +186,21 @@ class EventFeeder:
             self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             self._extras_ptr())
-        return self._finish_batch(n, (self._users, self._items))
+        return self._finish_batch(n, (self._users, self._items),
+                                  (time.perf_counter() - t0) * 1e3)
 
     def next_batch_cats(self) -> Optional[Tuple[np.ndarray, ...]]:
         """One batch of (cats [n, n_cat], values[, extras]); None at an
         epoch boundary.  Works for ANY column count (v3 caches)."""
+        t0 = time.perf_counter()
         n = self._lib.pio_feeder_next_batch_cats(
             self._h, self.batch_size,
             self._cats.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             self._vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             self._extras_ptr())
-        return self._finish_batch(n, (self._cats,))
+        return self._finish_batch(n, (self._cats,),
+                                  (time.perf_counter() - t0) * 1e3)
 
     def epoch(self) -> Iterator[Tuple[np.ndarray, ...]]:
         while True:

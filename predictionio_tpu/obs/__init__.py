@@ -1,0 +1,88 @@
+"""Unified observability layer (SURVEY §5.5 rebuild addition).
+
+Three parts, one process-wide state:
+
+- :mod:`predictionio_tpu.obs.metrics` — thread-safe Counter / Gauge /
+  Histogram registry with label support and THE Prometheus text renderer
+  behind every server's ``GET /metrics``.
+- :mod:`predictionio_tpu.obs.trace` — span/trace API with per-request
+  trace ids (``X-Request-ID``), a last-N ring buffer (``GET
+  /traces.json``), JSONL export (``PIO_TRACE_FILE``), and slow-request
+  logging (``PIO_SLOW_REQUEST_MS``).
+- :mod:`predictionio_tpu.obs.pipeline` — training-loop probe decomposing
+  the feeder→device pipeline into host-wait / H2D / device-step.
+
+stdlib-only on import: safe from the CLI, the servers, and the data layer
+without touching jax/numpy.
+"""
+
+from predictionio_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from predictionio_tpu.obs.pipeline import PipelineProbe
+from predictionio_tpu.obs.trace import (
+    Span,
+    TraceRecorder,
+    current_trace_id,
+    get_recorder,
+    new_trace_id,
+    sanitize_trace_id,
+    set_recorder,
+    slow_request_ms,
+    span,
+    trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "PipelineProbe",
+    "Span",
+    "TraceRecorder",
+    "current_trace_id",
+    "get_recorder",
+    "new_trace_id",
+    "sanitize_trace_id",
+    "set_recorder",
+    "slow_request_ms",
+    "span",
+    "trace",
+    "phase",
+    "reset_observability",
+]
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def phase(name: str, *, metric: str = "pio_train_phase_ms", **attrs):
+    """Span + per-phase duration histogram in one context manager.
+
+    The workflow's named phases (datasource / prepare / train / persist)
+    show up both in the trace tree AND as ``pio_train_phase_ms{phase=...}``
+    series, so a dashboard can watch phase drift without trace plumbing.
+    """
+    hist = get_registry().histogram(
+        metric, "Workflow phase duration by phase name.", ("phase",))
+    with span(name, **attrs) as s:
+        try:
+            yield s
+        finally:
+            # record crashed phases too — the runs most worth seeing
+            s.finish()
+            hist.observe(s.duration_ms or 0.0, phase=name)
+
+
+def reset_observability() -> None:
+    """Fresh registry + empty trace ring (test isolation; see conftest)."""
+    get_registry().reset()
+    get_recorder().clear()

@@ -1,0 +1,165 @@
+"""Training-pipeline probe: host-wait vs H2D vs device-step attribution.
+
+BENCH_r05 measured a 45.9% (two-tower) and 87.0% (DLRM) gap between raw
+feeder throughput and realized training examples/sec with no way to say
+which side of the pipeline stalls.  This probe decomposes every training
+iteration's wall time into named, separately-plotted components:
+
+- ``host_wait``  — time blocked fetching the next batch (feeder / numpy)
+- ``h2d``        — time converting + transferring the batch to device
+- ``device_wait``— time the HOST then stalls on the previous dispatched
+  step (the device-bound residual)
+- ``device_step``— dispatch→ready duration of each step (the device-step
+  histogram proper)
+
+The device measurements use a one-step lag so the probe never reduces
+host/device overlap: after batch N+1 is staged, the loop must wait for
+step N's output anyway (it is the next step's input), so blocking there
+and timing the block attributes exactly the stall the pipeline already
+pays.  wall ≈ host_wait + h2d + device_wait + loop overhead, which is the
+decomposition ISSUE/BENCH needed.
+
+jax is imported lazily inside the sync so this module (like all of obs)
+stays importable without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+from predictionio_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    MetricsRegistry,
+    get_registry,
+)
+
+__all__ = ["PipelineProbe"]
+
+
+class _Timed:
+    """Context manager recording elapsed ms into a histogram (+gauge)."""
+
+    __slots__ = ("_hist", "_gauge", "_labels", "_t0")
+
+    def __init__(self, hist, gauge, labels):
+        self._hist = hist
+        self._gauge = gauge
+        self._labels = labels
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        ms = (time.perf_counter() - self._t0) * 1e3
+        self._hist.observe(ms, **self._labels)
+        self._gauge.set(ms, **self._labels)
+        return False
+
+
+class PipelineProbe:
+    """Per-model training-loop instrumentation over the shared registry.
+
+    Integration shape (two_tower.train / dlrm.train)::
+
+        probe = PipelineProbe("dlrm")
+        for batch in probe.iter_host(epochs()):      # host_wait
+            with probe.h2d():                        # h2d
+                args = stage(batch)
+            probe.sync()                             # device_wait (step N-1)
+            state, loss = train_step(state, *args)
+            probe.dispatched(state, examples=len(batch))
+        probe.finish()                               # drain the last step
+    """
+
+    def __init__(self, model: str,
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry or get_registry()
+        self.model = model
+        self._labels = {"model": model}
+        labelnames = ("model",)
+        self._host_wait = reg.histogram(
+            "pio_train_host_wait_ms",
+            "Time blocked fetching the next training batch (host side).",
+            labelnames)
+        self._h2d = reg.histogram(
+            "pio_train_h2d_ms",
+            "Time staging a batch for the device (convert + transfer).",
+            labelnames)
+        self._device_wait = reg.histogram(
+            "pio_train_device_wait_ms",
+            "Host stall waiting on the previously dispatched device step.",
+            labelnames)
+        self._device_step = reg.histogram(
+            "pio_train_device_step_ms",
+            "Device-step duration: dispatch to outputs ready.",
+            labelnames)
+        self._last = {
+            "host_wait": reg.gauge(
+                "pio_train_last_host_wait_ms",
+                "host_wait of the most recent iteration.", labelnames),
+            "h2d": reg.gauge(
+                "pio_train_last_h2d_ms",
+                "h2d of the most recent iteration.", labelnames),
+            "device_wait": reg.gauge(
+                "pio_train_last_device_wait_ms",
+                "device_wait of the most recent iteration.", labelnames),
+        }
+        self._steps = reg.counter(
+            "pio_train_steps_total", "Optimizer steps run.", labelnames)
+        self._examples = reg.counter(
+            "pio_train_examples_total",
+            "Training examples consumed (pre-padding).", labelnames)
+        self._pending: Optional[Any] = None
+        self._pending_t0 = 0.0
+
+    # -- host side ---------------------------------------------------------
+
+    def iter_host(self, it: Iterable) -> Iterator:
+        """Wrap a batch iterator; each ``next()`` is timed as host_wait."""
+        it = iter(it)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            ms = (time.perf_counter() - t0) * 1e3
+            self._host_wait.observe(ms, **self._labels)
+            self._last["host_wait"].set(ms, **self._labels)
+            yield batch
+
+    def h2d(self) -> _Timed:
+        return _Timed(self._h2d, self._last["h2d"], self._labels)
+
+    # -- device side (one-step lag) ----------------------------------------
+
+    def sync(self) -> None:
+        """Block on the previous step's outputs; the block time is the
+        device-attributable stall, the dispatch→ready time is the step."""
+        if self._pending is None:
+            return
+        import jax
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(self._pending)
+        t1 = time.perf_counter()
+        self._device_wait.observe((t1 - t0) * 1e3, **self._labels)
+        self._last["device_wait"].set((t1 - t0) * 1e3, **self._labels)
+        self._device_step.observe((t1 - self._pending_t0) * 1e3,
+                                  **self._labels)
+        self._pending = None
+
+    def dispatched(self, outputs: Any, examples: int = 0) -> None:
+        """Register a freshly dispatched step's outputs for the next sync."""
+        self._pending = outputs
+        self._pending_t0 = time.perf_counter()
+        self._steps.inc(**self._labels)
+        if examples:
+            self._examples.inc(examples, **self._labels)
+
+    def finish(self) -> None:
+        """Drain the last in-flight step (end of the training loop)."""
+        self.sync()

@@ -30,12 +30,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs import get_registry
 from predictionio_tpu.ops.ragged import LEN_ALIGN, _round_up, fit_bounds
 
 __all__ = ["BucketPlan", "plan_buckets", "build_buckets", "degree_histogram"]
@@ -124,6 +126,7 @@ def plan_buckets(
     additionally needs ``over_degrees`` — the degrees of the over-cap
     entities in entity-id order (a tiny D2H).
     """
+    _t0 = time.perf_counter()
     pad_to = max(pad_rows_to, LEN_ALIGN)  # batch dim also sublane-aligned
     degrees = np.arange(len(hist))
     present = degrees[(hist > 0) & (degrees < len(hist))]
@@ -195,11 +198,30 @@ def plan_buckets(
                 sc.append((e0, e1, int(starts[e0]), int(starts[e1])))
                 e0 = e1
             split_chunks = tuple(sc) if len(sc) > 1 else ()
-    return BucketPlan(bounds=bounds, rows=rows, rows_padded=rows_padded,
+    plan = BucketPlan(bounds=bounds, rows=rows, rows_padded=rows_padded,
                       split_len=split_len, split_rows=split_rows,
                       split_segs=split_segs, n_rows=n_rows,
                       pad_rows_to=pad_to, plain_chunks=plain_chunks,
                       split_chunks=split_chunks)
+    # Pipeline observability: planning cost + how much padded HBM the
+    # device program will touch (ISSUE: make ALS prep attributable next
+    # to the feeder/training gauges).
+    reg = get_registry()
+    reg.histogram("pio_device_prep_plan_ms",
+                  "Host time planning the bucket layout.").observe(
+        (time.perf_counter() - _t0) * 1e3)
+    total_slots = plan.total_plain_slots + plan.split_rows * (plan.split_len
+                                                              or 0)
+    reg.gauge("pio_device_prep_total_slots",
+              "Padded entry slots the device layout allocates.").set(
+        total_slots)
+    reg.gauge("pio_device_prep_padded_rows",
+              "Padded rows across plain + split buckets.").set(
+        plan.total_plain_rows + plan.split_rows)
+    reg.gauge("pio_device_prep_buckets",
+              "Plain bucket count of the current plan.").set(
+        len(plan.bounds))
+    return plan
 
 
 @functools.partial(jax.jit, static_argnames=("plan",))

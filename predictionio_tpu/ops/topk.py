@@ -126,7 +126,9 @@ def sharded_top_k(
         top_s, pos = jax.lax.top_k(all_s, k)
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
-    fn = jax.shard_map(
+    from predictionio_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), P(axis)),

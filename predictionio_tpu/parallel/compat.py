@@ -1,0 +1,35 @@
+"""jax API compatibility shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and renamed ``check_rep`` → ``check_vma``) across the
+jax versions this repo meets in the wild; call sites import the one shim
+here instead of pinning either spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f: Callable, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None):
+    """``jax.shard_map`` where available, else the experimental one.
+
+    ``check_vma`` maps onto the old API's ``check_rep``; None means
+    "library default" on both.
+    """
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return sm(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(f, **kwargs)

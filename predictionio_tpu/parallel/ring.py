@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import AXIS_SEQUENCE
 
 __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
@@ -98,17 +99,23 @@ def ring_attention(
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return k_nxt, v_nxt, m_new, l_new, acc_new
 
-        # pcast-to-varying: the accumulators become device-varying after step 1; the
-        # loop carry must start with matching varying-axis types.
-        m0 = jax.lax.pcast(jnp.full((b, h, sl), _NEG, jnp.float32), axis, to='varying')
-        l0 = jax.lax.pcast(jnp.zeros((b, h, sl), jnp.float32), axis, to='varying')
-        acc0 = jax.lax.pcast(jnp.zeros((b, h, sl, d), jnp.float32), axis, to='varying')
+        # pcast-to-varying: the accumulators become device-varying after
+        # step 1; the loop carry must start with matching varying-axis
+        # types.  Older jax has no varying-axis tracking (and no pcast) —
+        # there the plain zeros ARE the right carry.
+        _pcast = getattr(jax.lax, "pcast", None)
+        if _pcast is None:
+            def _pcast(x, _axis, to):
+                return x
+        m0 = _pcast(jnp.full((b, h, sl), _NEG, jnp.float32), axis, to='varying')
+        l0 = _pcast(jnp.zeros((b, h, sl), jnp.float32), axis, to='varying')
+        acc0 = _pcast(jnp.zeros((b, h, sl, d), jnp.float32), axis, to='varying')
         _, _, m, l, acc = jax.lax.fori_loop(
             0, n, step, (k_blk, v_blk, m0, l0, acc0))
         out = acc / jnp.maximum(l, 1e-30)[..., None]          # [B,H,S/n,D]
         return out.transpose(0, 2, 1, 3).astype(q_blk.dtype)  # [B,S/n,H,D]
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
@@ -146,7 +153,7 @@ def ulysses_attention(
         out = local_attention(qf, kf, vf, causal=causal)
         return heads_to_seq(out)
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),

@@ -10,6 +10,12 @@ storage-bound, not compute-bound, and the serving hot path delegates to a
 compiled XLA executable either way.  The C++ continuous-batching frontend
 (SURVEY.md §7 step 9) replaces the engine server's request loop when p50
 latency matters.
+
+Transport plumbing shared by every server (backlog-tuned
+``ThreadingHTTPServer``, handler base, ``X-Request-ID`` glue) lives in
+:mod:`predictionio_tpu.server.http`; the metrics/tracing layer behind
+each server's ``/metrics`` and ``/traces.json`` is
+:mod:`predictionio_tpu.obs`.
 """
 
 from predictionio_tpu.server.event_server import EventServer

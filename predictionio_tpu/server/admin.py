@@ -16,16 +16,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
-
-
-class ThreadingHTTPServer(_ThreadingHTTPServer):
-    # Default accept backlog (5) resets connections under load bursts.
-    request_queue_size = 128
 from typing import Optional, Tuple
 from urllib.parse import urlparse
 
 from predictionio_tpu.data.storage import AccessKey, App, Storage, get_storage
+from predictionio_tpu.server.http import BaseHandler, ThreadingHTTPServer
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -99,24 +94,16 @@ class AdminServer:
             return 500, {"message": "Internal server error."}
 
     def _make_handler(server_self):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # Nagle + delayed-ACK between multi-write responses and a
-            # keep-alive client stalls every request ~40 ms (measured on
-            # the event server; same handler shape here).
-            disable_nagle_algorithm = True
+        class Handler(BaseHandler):
+            server_log_name = "admin"
 
             def _dispatch(self, method):
                 parsed = urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 status, payload = server_self.handle(method, parsed.path, body)
-                data = json.dumps(payload).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json; charset=UTF-8")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                self.respond(status, json.dumps(payload).encode(),
+                             "application/json; charset=UTF-8")
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")
@@ -126,9 +113,6 @@ class AdminServer:
 
             def do_DELETE(self):  # noqa: N802
                 self._dispatch("DELETE")
-
-            def log_message(self, fmt, *args):
-                logger.debug("admin %s", fmt % args)
 
         return Handler
 

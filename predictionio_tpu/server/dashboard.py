@@ -3,7 +3,8 @@
 Reference: tools/.../tools/dashboard/Dashboard.scala (SURVEY.md §2.1): an
 HTML listing of engine instances (status, times, params) and completed
 evaluations with their metric scores.  JSON endpoints added for tooling:
-``GET /engine_instances.json``, ``GET /evaluation_instances.json``.
+``GET /engine_instances.json``, ``GET /evaluation_instances.json``, plus
+the shared observability views ``GET /metrics`` / ``GET /traces.json``.
 """
 
 from __future__ import annotations
@@ -12,16 +13,25 @@ import html
 import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
-
-
-class ThreadingHTTPServer(_ThreadingHTTPServer):
-    # Default accept backlog (5) resets connections under load bursts.
-    request_queue_size = 128
+import time
 from typing import Optional, Tuple
 from urllib.parse import urlparse
 
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import (
+    current_trace_id,
+    get_recorder,
+    get_registry,
+    slow_request_ms,
+    span,
+    trace,
+)
+from predictionio_tpu.server.http import (
+    BaseHandler,
+    PROMETHEUS_CTYPE,
+    ThreadingHTTPServer,
+    incoming_request_id,
+)
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -39,6 +49,13 @@ class DashboardServer:
         self.storage = storage or get_storage()
         self.host = host
         self.port = port
+        self.registry = get_registry()
+        self._requests = self.registry.counter(
+            "pio_dashboard_requests_total",
+            "Dashboard requests by HTTP status.", ("status",))
+        self._latency = self.registry.histogram(
+            "pio_dashboard_request_latency_ms",
+            "Dashboard request handling latency.")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -85,6 +102,11 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
             return 404, "application/json", json.dumps({"message": "Not Found"})
         if path == "/":
             return 200, "text/html; charset=UTF-8", self._index_html()
+        if path == "/metrics":
+            return 200, PROMETHEUS_CTYPE, self.registry.render()
+        if path == "/traces.json":
+            return 200, "application/json", json.dumps(
+                {"traces": get_recorder().recent(50)})
         if path == "/engine_instances.json":
             rows = [
                 {"id": r.id, "status": r.status,
@@ -110,25 +132,27 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
     # -- HTTP ---------------------------------------------------------------
 
     def _make_handler(server_self):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # Nagle + delayed-ACK between multi-write responses and a
-            # keep-alive client stalls every request ~40 ms (measured on
-            # the event server; same handler shape here).
-            disable_nagle_algorithm = True
+        class Handler(BaseHandler):
+            server_log_name = "dashboard"
 
             def do_GET(self):  # noqa: N802
-                status, ctype, payload = server_self.handle(
-                    "GET", urlparse(self.path).path)
-                data = payload.encode()
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def log_message(self, fmt, *args):
-                logger.debug("dashboard %s", fmt % args)
+                t0 = time.perf_counter()
+                with trace("http.request",
+                           trace_id=incoming_request_id(self.headers),
+                           slow_ms=slow_request_ms(),
+                           server="dashboard", method="GET") as troot:
+                    path = urlparse(self.path).path
+                    troot.set(path=path)
+                    with span("http.handle"):
+                        status, ctype, payload = server_self.handle(
+                            "GET", path)
+                    troot.set(status=status)
+                    server_self._requests.inc(status=str(status))
+                    server_self._latency.observe(
+                        (time.perf_counter() - t0) * 1e3)
+                    with span("http.respond"):
+                        self.respond(status, payload.encode(), ctype,
+                                     request_id=current_trace_id())
 
         return Handler
 

@@ -13,25 +13,32 @@ latency histogram (SURVEY.md §5.5).
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import datetime as _dt
 import json
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
-
-
-class ThreadingHTTPServer(_ThreadingHTTPServer):
-    # Default accept backlog (5) resets connections under load bursts.
-    request_queue_size = 128
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from predictionio_tpu.controller import Engine, EngineVariant, RuntimeContext
 from predictionio_tpu.controller.params import bind_params
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import (
+    current_trace_id,
+    get_recorder,
+    get_registry,
+    slow_request_ms,
+    span,
+    trace,
+)
+from predictionio_tpu.server.http import (
+    BaseHandler,
+    ThreadingHTTPServer,
+    incoming_request_id,
+    payload_bytes,
+)
 from predictionio_tpu.version import __version__
 from predictionio_tpu.workflow.core_workflow import (
     WorkflowError,
@@ -76,38 +83,31 @@ class QueryError(ValueError):
     pass
 
 
-class _LatencyStats:
-    def __init__(self):
-        self.lock = threading.Lock()
-        self.count = 0
-        self.errors = 0
-        self.latencies_ms = collections.deque(maxlen=8192)
+class _QueryMetrics:
+    """Serving instruments over the shared registry; ``/metrics`` and
+    ``/stats.json`` are views of these series."""
+
+    def __init__(self, registry=None):
+        self.registry = registry or get_registry()
+        self.requests = self.registry.counter(
+            "pio_query_requests_total", "Predict requests served.")
+        self.errors = self.registry.counter(
+            "pio_query_errors_total", "Predict requests that failed.")
+        self.latency = self.registry.histogram(
+            "pio_query_latency_ms", "Predict request latency.")
 
     def record(self, ms: float, ok: bool) -> None:
-        with self.lock:
-            self.count += 1
-            if not ok:
-                self.errors += 1
-            self.latencies_ms.append(ms)
+        self.requests.inc()
+        if not ok:
+            self.errors.inc()
+        self.latency.observe(ms)
 
     def snapshot(self) -> Dict[str, Any]:
-        with self.lock:
-            lat = sorted(self.latencies_ms)
-            p = lambda q: lat[int(q * (len(lat) - 1))] if lat else 0.0  # noqa: E731
-            return {"requestCount": self.count, "errorCount": self.errors,
-                    "latencyMs": {"p50": p(0.5), "p95": p(0.95), "p99": p(0.99)}}
-
-    def prometheus(self) -> str:
-        s = self.snapshot()
-        lines = [
-            "# TYPE pio_query_requests_total counter",
-            f"pio_query_requests_total {s['requestCount']}",
-            f"pio_query_errors_total {s['errorCount']}",
-            "# TYPE pio_query_latency_ms summary",
-        ]
-        for q, v in s["latencyMs"].items():
-            lines.append(f'pio_query_latency_ms{{quantile="{q}"}} {v:.3f}')
-        return "\n".join(lines) + "\n"
+        return {"requestCount": int(self.requests.value()),
+                "errorCount": int(self.errors.value()),
+                "latencyMs": {"p50": self.latency.quantile(0.5),
+                              "p95": self.latency.quantile(0.95),
+                              "p99": self.latency.quantile(0.99)}}
 
 
 class EngineServer:
@@ -143,7 +143,7 @@ class EngineServer:
         self.engine_id = engine_id or variant.engine_factory
         self.engine_version = engine_version
         self.requested_instance_id = instance_id
-        self.stats = _LatencyStats()
+        self.stats = _QueryMetrics()
         self._swap_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -212,14 +212,25 @@ class EngineServer:
         return result
 
     def query(self, query_json: Any) -> Any:
-        """One predict round-trip (reference §3.2 hot path)."""
+        """One predict round-trip (reference §3.2 hot path).
+
+        Span-per-phase under an active trace: bind → supplement →
+        per-algorithm predict → serve.  Outside a trace each ``span`` is
+        two perf_counter calls — the hot path stays hot.
+        """
         with self._swap_lock:
             algorithms, models, serving = (
                 self._algorithms, self._models, self._serving)
-        q = self._bind_query(query_json)
-        q = serving.supplement(q)
-        predictions = [a.predict(m, q) for a, m in zip(algorithms, models)]
-        return self._result_to_json(serving.serve(q, predictions))
+        with span("predict.bind"):
+            q = self._bind_query(query_json)
+        with span("predict.supplement"):
+            q = serving.supplement(q)
+        predictions = []
+        for a, m in zip(algorithms, models):
+            with span("predict.algorithm", algo=type(a).__name__):
+                predictions.append(a.predict(m, q))
+        with span("predict.serve"):
+            return self._result_to_json(serving.serve(q, predictions))
 
     def query_batch(self, query_jsons: List[Any]) -> List[Any]:
         """Batched predict for the native continuous-batching frontend:
@@ -256,7 +267,12 @@ class EngineServer:
                     "version": __version__,
                 }
             if path == "/metrics" and method == "GET":
-                return 200, self.stats.prometheus()
+                # THE process-wide exposition (shared registry render).
+                return 200, self.stats.registry.render()
+            if path == "/stats.json" and method == "GET":
+                return 200, self.stats.snapshot()
+            if path == "/traces.json" and method == "GET":
+                return 200, {"traces": get_recorder().recent(50)}
             if path == "/reload" and method == "POST":
                 instance_id = self.reload()
                 return 200, {"status": "reloaded",
@@ -284,45 +300,38 @@ class EngineServer:
             return 500, {"message": "Internal server error."}
 
     def _make_handler(server_self):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # Nagle + delayed-ACK between multi-write responses and a
-            # keep-alive client stalls every request ~40 ms (measured on
-            # the event server; same handler shape here).
-            disable_nagle_algorithm = True
+        class Handler(BaseHandler):
+            server_log_name = "engine-server"
 
             def _dispatch(self, method: str):
                 t0 = time.perf_counter()
-                parsed = urlparse(self.path)
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                status, payload = server_self.handle(method, parsed.path, body)
-                if isinstance(payload, str):
-                    data = payload.encode()
-                    ctype = "text/plain; version=0.0.4"
-                else:
-                    data = json.dumps(payload).encode()
-                    ctype = "application/json; charset=UTF-8"
-                extra = server_self.plugins.on_request(
-                    f"{method} {parsed.path}", status,
-                    (time.perf_counter() - t0) * 1e3) \
-                    if server_self.plugins else {}
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
+                with trace("http.request",
+                           trace_id=incoming_request_id(self.headers),
+                           slow_ms=slow_request_ms(),
+                           server="engine", method=method) as troot:
+                    parsed = urlparse(self.path)
+                    troot.set(path=parsed.path)
+                    with span("http.read"):
+                        length = int(self.headers.get("Content-Length") or 0)
+                        body = self.rfile.read(length) if length else b""
+                    with span("http.handle"):
+                        status, payload = server_self.handle(
+                            method, parsed.path, body)
+                    troot.set(status=status)
+                    extra = server_self.plugins.on_request(
+                        f"{method} {parsed.path}", status,
+                        (time.perf_counter() - t0) * 1e3) \
+                        if server_self.plugins else {}
+                    with span("http.respond"):
+                        data, ctype = payload_bytes(payload)
+                        self.respond(status, data, ctype, extra,
+                                     request_id=current_trace_id())
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")
 
             def do_POST(self):  # noqa: N802
                 self._dispatch("POST")
-
-            def log_message(self, fmt, *args):
-                logger.debug("engine-server %s", fmt % args)
 
         return Handler
 

@@ -23,18 +23,11 @@ enforced on write.
 from __future__ import annotations
 
 import base64
-import collections
 import datetime as _dt
 import json
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer as _ThreadingHTTPServer
-
-
-class ThreadingHTTPServer(_ThreadingHTTPServer):
-    # Default accept backlog (5) resets connections under load bursts.
-    request_queue_size = 128
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -45,6 +38,20 @@ from predictionio_tpu.data.json_support import (
     parse_iso8601,
 )
 from predictionio_tpu.data.storage import Storage, StorageError, get_storage
+from predictionio_tpu.obs import (
+    current_trace_id,
+    get_recorder,
+    get_registry,
+    slow_request_ms,
+    span,
+    trace,
+)
+from predictionio_tpu.server.http import (
+    BaseHandler,
+    ThreadingHTTPServer,
+    incoming_request_id,
+    payload_bytes,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -53,43 +60,43 @@ __all__ = ["EventServer", "MAX_BATCH_SIZE"]
 MAX_BATCH_SIZE = 50  # reference: EventServer batch cap
 
 
-class _Stats:
-    """In-memory ingest counters (reference: Stats/StatsActor)."""
+class _EventMetrics:
+    """Ingest instruments over the shared registry (reference:
+    Stats/StatsActor).  ``/stats.json`` and ``/metrics`` are both views
+    of these series — there is no second set of counters."""
 
-    def __init__(self):
-        self.lock = threading.Lock()
+    def __init__(self, registry=None):
+        self.registry = registry or get_registry()
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
-        self.by_status: Dict[int, int] = collections.Counter()
-        self.by_event: Dict[str, int] = collections.Counter()
-        self.latencies_ms: collections.deque = collections.deque(maxlen=4096)
+        self.requests = self.registry.counter(
+            "pio_event_requests_total",
+            "Event API requests by HTTP status.", ("status",))
+        self.events = self.registry.counter(
+            "pio_event_events_total",
+            "Accepted events by event name.", ("event",))
+        self.latency = self.registry.histogram(
+            "pio_event_request_latency_ms",
+            "Event API request handling latency.")
 
     def record(self, status: int, event_name: Optional[str], ms: float) -> None:
-        with self.lock:
-            self.by_status[status] += 1
-            if event_name:
-                self.by_event[event_name] += 1
-            self.latencies_ms.append(ms)
+        self.requests.inc(status=str(status))
+        if event_name:
+            self.events.inc(event=event_name)
+        self.latency.observe(ms)
 
     def snapshot(self) -> Dict[str, Any]:
-        with self.lock:
-            lat = sorted(self.latencies_ms)
-            p = lambda q: lat[int(q * (len(lat) - 1))] if lat else 0.0  # noqa: E731
-            return {
-                "startTime": self.start_time.isoformat(),
-                "statusCounts": {str(k): v for k, v in self.by_status.items()},
-                "eventCounts": dict(self.by_event),
-                "latencyMs": {"p50": p(0.5), "p95": p(0.95), "p99": p(0.99)},
-            }
-
-    def prometheus(self) -> str:
-        snap = self.snapshot()
-        lines = ["# TYPE pio_event_requests_total counter"]
-        for status, n in snap["statusCounts"].items():
-            lines.append(f'pio_event_requests_total{{status="{status}"}} {n}')
-        lines.append("# TYPE pio_event_request_latency_ms summary")
-        for q, v in snap["latencyMs"].items():
-            lines.append(f'pio_event_request_latency_ms{{quantile="{q}"}} {v:.3f}')
-        return "\n".join(lines) + "\n"
+        """The legacy /stats.json shape, read back off the registry
+        (quantiles are bucket-interpolated estimates)."""
+        return {
+            "startTime": self.start_time.isoformat(),
+            "statusCounts": {k[0]: int(v)
+                             for k, v in self.requests.series().items()},
+            "eventCounts": {k[0]: int(v)
+                            for k, v in self.events.series().items()},
+            "latencyMs": {"p50": self.latency.quantile(0.5),
+                          "p95": self.latency.quantile(0.95),
+                          "p99": self.latency.quantile(0.99)},
+        }
 
 
 class EventServer:
@@ -102,7 +109,7 @@ class EventServer:
         self.storage = storage or get_storage()
         self.host = host
         self.port = port
-        self.stats = _Stats()
+        self.stats = _EventMetrics()
         # Positive accessKey cache (5 s TTL): the ingest hot path otherwise
         # pays a metadata SELECT per request.  Key revocation propagates
         # within the TTL; auth FAILURES are never cached.
@@ -175,11 +182,18 @@ class EventServer:
         if path == "/stats.json" and method == "GET":
             return 200, self.stats.snapshot()
         if path == "/metrics" and method == "GET":
-            return 200, self.stats.prometheus()
+            # THE process-wide exposition: every subsystem's instruments
+            # (ingest, serving, training, plugins) in one scrape.
+            return 200, self.stats.registry.render()
 
         key_row, err = self._auth(params, headers)
         if err:
             return err, {"message": "Invalid accessKey."}
+
+        if path == "/traces.json" and method == "GET":
+            # Behind accessKey, unlike the aggregate /metrics//stats.json
+            # views: traces carry PER-REQUEST paths/timings/request ids.
+            return 200, {"traces": get_recorder().recent(50)}
         channel_id, cerr = self._resolve_channel(key_row.app_id, params)
         if cerr:
             return 400, {"message": cerr}
@@ -278,47 +292,44 @@ class EventServer:
     # -- HTTP plumbing ------------------------------------------------------
 
     def _make_handler(server_self):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            # Nagle + delayed-ACK between our multi-write responses and a
-            # keep-alive client stalls every request ~40 ms (measured:
-            # 44 ms/req with a persistent connection, 0.9 ms without).
-            disable_nagle_algorithm = True
+        class Handler(BaseHandler):
+            server_log_name = "event-server"
 
             def _dispatch(self, method: str):
                 t0 = time.perf_counter()
-                parsed = urlparse(self.path)
-                params = parse_qs(parsed.query)
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                status, payload = server_self.handle(
-                    method, parsed.path, params, body, self.headers)
-                if isinstance(payload, str):  # /metrics text exposition
-                    data = payload.encode()
-                    ctype = "text/plain; version=0.0.4"
-                else:
-                    data = json.dumps(payload).encode()
-                    ctype = "application/json; charset=UTF-8"
-                name = None
-                if method == "POST" and parsed.path == "/events.json" and status == 201:
-                    try:
-                        name = json.loads(body).get("event")
-                    except Exception:
-                        name = None
-                # Record BEFORE replying: a client reading /stats.json right
-                # after its POST completes must see its own event counted.
-                ms = (time.perf_counter() - t0) * 1e3
-                server_self.stats.record(status, name, ms)
-                extra = server_self.plugins.on_request(
-                    f"{method} {parsed.path}", status, ms) \
-                    if server_self.plugins else {}
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
+                with trace("http.request",
+                           trace_id=incoming_request_id(self.headers),
+                           slow_ms=slow_request_ms(),
+                           server="event", method=method) as troot:
+                    parsed = urlparse(self.path)
+                    troot.set(path=parsed.path)
+                    params = parse_qs(parsed.query)
+                    with span("http.read"):
+                        length = int(self.headers.get("Content-Length") or 0)
+                        body = self.rfile.read(length) if length else b""
+                    with span("http.handle"):
+                        status, payload = server_self.handle(
+                            method, parsed.path, params, body, self.headers)
+                    troot.set(status=status)
+                    name = None
+                    if method == "POST" and parsed.path == "/events.json" \
+                            and status == 201:
+                        try:
+                            name = json.loads(body).get("event")
+                        except Exception:
+                            name = None
+                    # Record BEFORE replying: a client reading /stats.json
+                    # right after its POST completes must see its own event
+                    # counted.
+                    ms = (time.perf_counter() - t0) * 1e3
+                    server_self.stats.record(status, name, ms)
+                    extra = server_self.plugins.on_request(
+                        f"{method} {parsed.path}", status, ms) \
+                        if server_self.plugins else {}
+                    with span("http.respond"):
+                        data, ctype = payload_bytes(payload)
+                        self.respond(status, data, ctype, extra,
+                                     request_id=current_trace_id())
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")
@@ -328,9 +339,6 @@ class EventServer:
 
             def do_DELETE(self):  # noqa: N802
                 self._dispatch("DELETE")
-
-            def log_message(self, fmt, *args):
-                logger.debug("event-server %s", fmt % args)
 
         return Handler
 

@@ -1,0 +1,92 @@
+"""Shared HTTP plumbing for every predictionio_tpu server.
+
+One place for the transport knobs the Event Server, Engine Server,
+Dashboard, and Admin server previously each copy-pasted, plus the
+request-id glue every frontend speaks:
+
+- :class:`ThreadingHTTPServer` — stdlib ``ThreadingHTTPServer`` with a
+  128-deep accept backlog (the default of 5 resets connections under
+  load bursts; measured on the event server).
+- ``X-Request-ID`` handling: :func:`incoming_request_id` pulls and
+  sanitizes the client-supplied id (or None → the tracer generates one);
+  every response carries the effective id back, so a client (or an
+  upstream proxy) can join its logs to the server's trace/JSONL records.
+- :class:`BaseHandler` — the per-request handler skeleton: HTTP/1.1
+  keep-alive, Nagle off (Nagle + delayed-ACK between our multi-write
+  responses and a keep-alive client stalls every request ~40 ms —
+  measured: 44 ms/req persistent vs 0.9 ms without), debug-level access
+  logs, and a :meth:`BaseHandler.respond` helper that writes a JSON or
+  Prometheus-text payload with Content-Length and the request-id header.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import (
+    BaseHTTPRequestHandler,
+    ThreadingHTTPServer as _ThreadingHTTPServer,
+)
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.obs.trace import sanitize_trace_id
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ThreadingHTTPServer",
+    "BaseHandler",
+    "REQUEST_ID_HEADER",
+    "PROMETHEUS_CTYPE",
+    "incoming_request_id",
+    "payload_bytes",
+]
+
+REQUEST_ID_HEADER = "X-Request-ID"
+PROMETHEUS_CTYPE = "text/plain; version=0.0.4"
+
+
+class ThreadingHTTPServer(_ThreadingHTTPServer):
+    # Default accept backlog (5) resets connections under load bursts.
+    request_queue_size = 128
+
+
+def incoming_request_id(headers) -> Optional[str]:
+    """Sanitized client-supplied ``X-Request-ID`` (None → generate one)."""
+    if headers is None:
+        return None
+    return sanitize_trace_id(headers.get(REQUEST_ID_HEADER))
+
+
+def payload_bytes(payload: Any) -> Tuple[bytes, str]:
+    """(body, content-type) for a handler payload: ``str`` means
+    Prometheus text exposition, anything else is JSON."""
+    if isinstance(payload, str):
+        return payload.encode(), PROMETHEUS_CTYPE
+    return json.dumps(payload).encode(), "application/json; charset=UTF-8"
+
+
+class BaseHandler(BaseHTTPRequestHandler):
+    """Shared request-handler skeleton; subclasses implement do_* via
+    their server's dispatch and reply through :meth:`respond`."""
+
+    protocol_version = "HTTP/1.1"
+    # See module docstring: keep-alive + Nagle stalls every request ~40 ms.
+    disable_nagle_algorithm = True
+    server_log_name = "server"
+
+    def respond(self, status: int, data: bytes, ctype: str,
+                extra_headers: Optional[Dict[str, str]] = None,
+                request_id: Optional[str] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        if request_id:
+            self.send_header(REQUEST_ID_HEADER, request_id)
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s %s", self.server_log_name, fmt % args)

@@ -30,7 +30,8 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ServerPlugin", "PluginManager"]
+__all__ = ["ServerPlugin", "PluginManager", "MetricsPlugin",
+           "make_metrics_plugin"]
 
 
 class ServerPlugin:
@@ -60,6 +61,49 @@ class ServerPlugin:
 def _sanitize(s: str) -> str:
     """Strip CR/LF so a plugin-supplied value cannot inject headers."""
     return str(s).replace("\r", " ").replace("\n", " ")
+
+
+class MetricsPlugin(ServerPlugin):
+    """Exemplar plugin: feed ``on_request`` into the shared obs registry.
+
+    Proves the plugin seam and the built-in server instrumentation report
+    through the SAME pipeline: this plugin's
+    ``pio_plugin_requests_total{route,status}`` series and the server's
+    built-in ``pio_*_requests_total`` counters land in one registry and
+    one ``/metrics`` exposition, and must agree on totals (pinned by
+    tests/test_servers.py).  Enable with::
+
+        PIO_EVENTSERVER_PLUGINS=predictionio_tpu.server.plugins:make_metrics_plugin
+
+    Note the ``route`` label carries the raw request path, so its
+    cardinality is client-controlled (e.g. ``/events/<id>.json``) — fine
+    for a trusted deployment, something to aggregate for a public one.
+    """
+
+    name = "metrics"
+
+    def __init__(self, registry=None):
+        from predictionio_tpu.obs import get_registry
+
+        reg = registry or get_registry()
+        self.requests = reg.counter(
+            "pio_plugin_requests_total",
+            "Requests seen by the metrics plugin, by route and status.",
+            ("route", "status"))
+        self.latency = reg.histogram(
+            "pio_plugin_request_latency_ms",
+            "Request latency as seen by the metrics plugin.")
+
+    def on_request(self, route: str, status: int,
+                   ms: float) -> Optional[Dict[str, str]]:
+        self.requests.inc(route=route, status=str(status))
+        self.latency.observe(ms)
+        return None
+
+
+def make_metrics_plugin() -> MetricsPlugin:
+    """Env-spec factory (``module:factory`` discovery contract)."""
+    return MetricsPlugin()
 
 
 class PluginManager:

@@ -41,6 +41,7 @@ from predictionio_tpu.data.storage import (
     Model,
     Storage,
 )
+from predictionio_tpu.obs import phase as obs_phase, trace as obs_trace
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -100,8 +101,16 @@ def run_train(
     instance_id = instances.insert(instance)
     logger.info("EngineInstance %s TRAINING (factory=%s)", instance_id, variant.engine_factory)
     try:
-        models = _maybe_profiled(ctx, lambda: engine.train(ctx, engine_params))
-        _persist_models(models, instance_id, ctx)
+        # One trace per training run: the DASE phases inside Engine.train
+        # (datasource/prepare/algorithm) plus the persist phase below hang
+        # off this root; recorded to the ring / PIO_TRACE_FILE on exit.
+        with obs_trace("workflow.train",
+                       engine_factory=variant.engine_factory,
+                       instance=instance_id):
+            models = _maybe_profiled(
+                ctx, lambda: engine.train(ctx, engine_params))
+            with obs_phase("train.persist"):
+                _persist_models(models, instance_id, ctx)
         instance.status = "COMPLETED"
         instance.end_time = _now()
         instances.update(instance)

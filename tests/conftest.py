@@ -35,8 +35,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture()
 def pio_home(tmp_path, monkeypatch):
-    """Isolated PIO_HOME per test (fresh storage singleton both sides)."""
+    """Isolated PIO_HOME per test (fresh storage singleton both sides).
+
+    Also resets the process-wide observability state (metrics registry +
+    trace ring): servers share ONE registry by design, so without a reset
+    each test would see the previous tests' counts.
+    """
     from predictionio_tpu.data.storage import reset_storage
+    from predictionio_tpu.obs import reset_observability
 
     home = tmp_path / "pio_home"
     home.mkdir()
@@ -45,5 +51,7 @@ def pio_home(tmp_path, monkeypatch):
         if k.startswith("PIO_STORAGE_"):
             monkeypatch.delenv(k, raising=False)
     reset_storage()
+    reset_observability()
     yield home
     reset_storage()
+    reset_observability()

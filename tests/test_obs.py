@@ -1,0 +1,328 @@
+"""obs/ unit tests: registry semantics, renderer validity, tracing,
+pipeline probe.
+
+``parse_prometheus`` doubles as the suite's Prometheus text-format
+validator (no prometheus_client in the image): strict line grammar,
+TYPE-before-samples, cumulative ``le`` buckets, ``+Inf`` == ``_count``.
+test_servers.py imports it to validate live ``/metrics`` output.
+"""
+
+import json
+import math
+import re
+import threading
+
+import pytest
+
+from predictionio_tpu.obs import (
+    MetricsRegistry,
+    PipelineProbe,
+    TraceRecorder,
+    get_recorder,
+    get_registry,
+    phase,
+    reset_observability,
+    sanitize_trace_id,
+    span,
+    trace,
+)
+
+# -- Prometheus text-format parser/validator --------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{(.*)\}})? (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|Inf)|\+Inf|NaN)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)')
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    if s == "NaN":
+        return math.nan
+    return float(s)
+
+
+def parse_prometheus(text: str):
+    """Validate + parse exposition text → {name: [(labels_dict, value)]}.
+
+    Raises AssertionError on any malformed line, samples without a
+    preceding # TYPE, non-cumulative histogram buckets, or +Inf bucket
+    disagreeing with _count.
+    """
+    samples = {}
+    types = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"bad TYPE line: {line!r}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert base in types or name in types, \
+            f"sample {name!r} has no # TYPE"
+        labels = {}
+        if labels_raw:
+            consumed = sum(len(mm.group(0)) for mm in
+                           _LABEL_RE.finditer(labels_raw))
+            assert consumed == len(labels_raw), \
+                f"malformed labels: {labels_raw!r}"
+            for mm in _LABEL_RE.finditer(labels_raw):
+                labels[mm.group(1)] = mm.group(2)
+        samples.setdefault(name, []).append((labels, _parse_value(value)))
+    # histogram invariants
+    for name, kind in types.items():
+        if kind != "histogram":
+            continue
+        series = {}
+        for labels, v in samples.get(f"{name}_bucket", []):
+            key = tuple(sorted((k, lv) for k, lv in labels.items()
+                               if k != "le"))
+            le = math.inf if labels["le"] == "+Inf" else float(labels["le"])
+            series.setdefault(key, []).append((le, v))
+        counts = {tuple(sorted(labels.items())): v
+                  for labels, v in samples.get(f"{name}_count", [])}
+        for key, bs in series.items():
+            bs.sort()
+            cums = [v for _, v in bs]
+            assert cums == sorted(cums), f"{name}{key}: buckets not cumulative"
+            assert bs[-1][0] == math.inf, f"{name}{key}: no +Inf bucket"
+            assert bs[-1][1] == counts[key], \
+                f"{name}{key}: +Inf bucket != _count"
+    return samples
+
+
+# -- registry ---------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_labels_and_values(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_t_total", "t", ("status",))
+        c.inc(status="200")
+        c.inc(2, status="404")
+        assert c.value(status="200") == 1
+        assert c.value(status="404") == 2
+        assert c.total() == 3
+        with pytest.raises(ValueError):
+            c.inc(status="200", extra="nope")
+        with pytest.raises(ValueError):
+            c.inc(-1, status="200")
+
+    def test_get_or_create_and_mismatch(self):
+        reg = MetricsRegistry()
+        a = reg.counter("pio_x_total", "x")
+        assert reg.counter("pio_x_total") is a
+        with pytest.raises(ValueError):
+            reg.gauge("pio_x_total")
+        with pytest.raises(ValueError):
+            reg.counter("pio_x_total", labelnames=("other",))
+        with pytest.raises(ValueError):
+            reg.counter("0bad name")
+        h = reg.histogram("pio_x_ms", buckets=(1, 10))
+        assert reg.histogram("pio_x_ms", buckets=(1, 10)) is h
+        with pytest.raises(ValueError):
+            reg.histogram("pio_x_ms", buckets=(5, 50))
+
+    def test_phase_records_even_on_exception(self):
+        reset_observability()
+        with pytest.raises(RuntimeError):
+            with trace("workflow.train"):
+                with phase("train.datasource"):
+                    raise RuntimeError("boom")
+        h = get_registry().get("pio_train_phase_ms")
+        assert h.count(phase="train.datasource") == 1
+
+    def test_label_escaping_round_trips(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_esc_total", "h", ("route",))
+        nasty = 'a"b\\c\nd'
+        c.inc(route=nasty)
+        text = reg.render()
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        samples = parse_prometheus(text)
+        (labels, value), = samples["pio_esc_total"]
+        assert value == 1
+        # unescape what the renderer escaped — must round-trip
+        unescaped = (labels["route"].replace("\\\\", "\x00")
+                     .replace('\\"', '"').replace("\\n", "\n")
+                     .replace("\x00", "\\"))
+        assert unescaped == nasty
+
+    def test_histogram_buckets_and_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_h_ms", "h", buckets=(1, 10, 100))
+        for v in (0.5, 5, 5, 50, 500):
+            h.observe(v)
+        assert h.count() == 5
+        assert h.sum() == 560.5
+        samples = parse_prometheus(reg.render())
+        le_counts = {labels["le"]: v
+                     for labels, v in samples["pio_h_ms_bucket"]}
+        assert le_counts == {"1": 1, "10": 3, "100": 4, "+Inf": 5}
+        # interpolated median lands inside the (1, 10] bucket
+        assert 1 <= h.quantile(0.5) <= 10
+        # +Inf-bucket quantiles report the top finite bound
+        assert h.quantile(0.999) == 100
+
+    def test_concurrent_increments_lose_nothing(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_c_total", "c", ("worker",))
+        h = reg.histogram("pio_ch_ms", "h")
+        n_threads, per = 8, 500
+
+        def work(i):
+            for _ in range(per):
+                c.inc(worker=str(i % 2))
+                h.observe(1.0)
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.total() == n_threads * per
+        assert h.count() == n_threads * per
+
+    def test_unlabelled_counter_renders_bare(self):
+        reg = MetricsRegistry()
+        reg.counter("pio_bare_total", "b").inc()
+        assert "pio_bare_total 1\n" in reg.render()
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("pio_g", "g")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 6
+
+    def test_render_is_valid_when_empty_and_after_reset(self):
+        reg = MetricsRegistry()
+        reg.counter("pio_a_total", "a", ("x",))
+        parse_prometheus(reg.render())
+        reg.reset()
+        assert reg.render() == "\n" or parse_prometheus(reg.render()) == {}
+
+
+# -- tracing ----------------------------------------------------------------
+
+class TestTracing:
+    def setup_method(self):
+        reset_observability()
+
+    def test_span_tree_and_ring(self):
+        with trace("root", trace_id="tid-1", a=1) as t:
+            with span("child1"):
+                with span("grand"):
+                    pass
+            with span("child2", algo="als"):
+                pass
+        assert t.duration_ms is not None
+        docs = get_recorder().recent(5)
+        assert docs and docs[0]["traceId"] == "tid-1"
+        names = [s["name"] for s in docs[0]["spans"]]
+        assert names == ["child1", "child2"]
+        assert docs[0]["spans"][0]["spans"][0]["name"] == "grand"
+        assert docs[0]["spans"][1]["attrs"] == {"algo": "als"}
+
+    def test_span_outside_trace_records_nothing(self):
+        with span("orphan") as s:
+            pass
+        assert s.duration_ms is not None
+        assert get_recorder().recent(5) == []
+
+    def test_nested_trace_degrades_to_span(self):
+        with trace("outer"):
+            with trace("inner"):
+                pass
+        docs = get_recorder().recent(5)
+        assert len(docs) == 1
+        assert [s["name"] for s in docs[0]["spans"]] == ["inner"]
+
+    def test_jsonl_export(self, tmp_path, monkeypatch):
+        out = tmp_path / "traces.jsonl"
+        monkeypatch.setenv("PIO_TRACE_FILE", str(out))
+        with trace("one"):
+            pass
+        with trace("two"):
+            with span("s"):
+                pass
+        lines = [json.loads(line) for line in
+                 out.read_text().strip().splitlines()]
+        assert [d["name"] for d in lines] == ["one", "two"]
+        assert all("traceId" in d and "durationMs" in d for d in lines)
+
+    def test_ring_is_bounded(self):
+        rec = TraceRecorder(ring_size=3)
+        for i in range(5):
+            with trace(f"t{i}", recorder=rec):
+                pass
+        assert [d["name"] for d in rec.recent(10)] == ["t4", "t3", "t2"]
+
+    def test_slow_trace_logs_warning(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.obs.trace"):
+            with trace("fast", slow_ms=10000):
+                pass
+            assert not caplog.records
+            with trace("slow", slow_ms=0.0000001):
+                pass
+        assert any("slow" in r.message for r in caplog.records)
+
+    def test_sanitize_trace_id(self):
+        assert sanitize_trace_id(None) is None
+        assert sanitize_trace_id("") is None
+        assert sanitize_trace_id("ab-c_1.2:3") == "ab-c_1.2:3"
+        # CRLF and header-splitting characters are stripped
+        assert sanitize_trace_id("a\r\nSet-Cookie: x") == "aSet-Cookie:x"
+        assert sanitize_trace_id("\r\n") is None
+        assert len(sanitize_trace_id("x" * 500)) == 128
+
+    def test_phase_records_span_and_histogram(self):
+        reset_observability()
+        with trace("workflow.train"):
+            with phase("train.datasource"):
+                pass
+        h = get_registry().get("pio_train_phase_ms")
+        assert h.count(phase="train.datasource") == 1
+        doc = get_recorder().recent(1)[0]
+        assert doc["spans"][0]["name"] == "train.datasource"
+
+
+# -- pipeline probe ---------------------------------------------------------
+
+class TestPipelineProbe:
+    def test_decomposition_counts(self):
+        reg = MetricsRegistry()
+        probe = PipelineProbe("toy", registry=reg)
+        batches = [([1, 2], [3, 4]), ([5], [6])]
+        seen = []
+        for b in probe.iter_host(iter(batches)):
+            with probe.h2d():
+                staged = b
+            probe.sync()
+            seen.append(staged)
+            probe.dispatched({"step": len(seen)}, examples=len(b[0]))
+        probe.finish()
+        assert seen == batches
+        assert reg.get("pio_train_steps_total").value(model="toy") == 2
+        assert reg.get("pio_train_examples_total").value(model="toy") == 3
+        assert reg.get("pio_train_host_wait_ms").count(model="toy") == 2
+        assert reg.get("pio_train_h2d_ms").count(model="toy") == 2
+        # one-step lag: first sync is a no-op, finish drains the last
+        assert reg.get("pio_train_device_wait_ms").count(model="toy") == 2
+        parse_prometheus(reg.render())

@@ -135,7 +135,59 @@ class TestEventServer:
         req = urllib.request.Request(f"{base}/metrics")
         with urllib.request.urlopen(req, timeout=10) as resp:
             text = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
         assert "pio_event_requests_total" in text
+        # the exposition must be valid Prometheus text (strict parser)
+        from tests.test_obs import parse_prometheus
+
+        samples = parse_prometheus(text)
+        assert ({"status": "201"}, 1.0) in samples["pio_event_requests_total"]
+        assert ({"event": "view"}, 1.0) in samples["pio_event_events_total"]
+        assert samples["pio_event_request_latency_ms_count"][0][1] >= 1
+
+    def test_request_id_round_trips(self, event_server):
+        srv, *_ = event_server
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(f"{base}/",
+                                     headers={"X-Request-ID": "client-id-42"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["X-Request-ID"] == "client-id-42"
+        # absent → server generates one and still returns it
+        with urllib.request.urlopen(f"{base}/", timeout=10) as resp:
+            gen = resp.headers["X-Request-ID"]
+        assert gen and len(gen) == 32 and gen != "client-id-42"
+        # hostile ids are sanitized, not echoed raw
+        req = urllib.request.Request(
+            f"{base}/", headers={"X-Request-ID": "a\tb c"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["X-Request-ID"] == "abc"
+
+    def test_traces_json_records_requests(self, event_server):
+        import time
+
+        srv, key, *_ = event_server
+        base = f"http://127.0.0.1:{srv.port}"
+        ev = {"event": "view", "entityType": "user", "entityId": "u1"}
+        _req("POST", f"{base}/events.json?accessKey={key}&", ev)
+        # per-request traces require auth (unlike the aggregate /metrics)
+        assert _req("GET", f"{base}/traces.json")[0] == 401
+        # the trace is recorded just AFTER the response bytes go out
+        posts = []
+        for _ in range(50):
+            status, body = _req("GET", f"{base}/traces.json?accessKey={key}")
+            assert status == 200
+            posts = [t for t in body["traces"]
+                     if t["attrs"].get("path") == "/events.json"]
+            if posts:
+                break
+            time.sleep(0.02)
+        assert posts, "POST /events.json trace never reached the ring"
+        t = posts[0]
+        assert t["name"] == "http.request"
+        assert t["attrs"]["server"] == "event"
+        assert t["attrs"]["status"] == 201
+        names = [s["name"] for s in t["spans"]]
+        assert names == ["http.read", "http.handle", "http.respond"]
 
 
 @pytest.fixture()
@@ -205,6 +257,76 @@ class TestEngineServer:
         with urllib.request.urlopen(req, timeout=10) as resp:
             text = resp.read().decode()
         assert "pio_query_requests_total 1" in text
+        from tests.test_obs import parse_prometheus
+
+        samples = parse_prometheus(text)
+        assert samples["pio_query_latency_ms_count"][0][1] == 1
+        # the registry is process-wide: training-phase series from the
+        # fixture's run_train surface in the SERVING exposition too
+        assert any(lb.get("phase") == "train.algorithm"
+                   for lb, _ in samples.get("pio_train_phase_ms_count", []))
+
+    def test_stats_json_view(self, deployed):
+        srv, *_ = deployed
+        _req("POST", f"http://127.0.0.1:{srv.port}/queries.json",
+             {"user": "u0", "num": 2})
+        status, stats = _req("GET",
+                             f"http://127.0.0.1:{srv.port}/stats.json")
+        assert status == 200
+        assert stats["requestCount"] == 1 and stats["errorCount"] == 0
+        assert stats["latencyMs"]["p50"] >= 0
+
+    def test_query_trace_covers_wall_time(self, deployed, tmp_path,
+                                          monkeypatch):
+        """Acceptance: a served query's trace decomposes into spans with
+        no large unattributed gap, and exports as JSONL."""
+        import json as _json
+        import time
+
+        trace_file = tmp_path / "traces.jsonl"
+        monkeypatch.setenv("PIO_TRACE_FILE", str(trace_file))
+        srv, *_ = deployed
+        # several queries: the first pays bytecode/jit warm-up; the
+        # steady-state ones must hit the 95% attribution target
+        for _ in range(4):
+            status, _ = _req("POST",
+                             f"http://127.0.0.1:{srv.port}/queries.json",
+                             {"user": "u0", "num": 3})
+            assert status == 200
+        docs = []
+        for _ in range(50):
+            if trace_file.exists():
+                docs = [_json.loads(line) for line in
+                        trace_file.read_text().strip().splitlines()]
+                if sum(d["attrs"].get("path") == "/queries.json"
+                       for d in docs) >= 4:
+                    break
+            time.sleep(0.02)
+        traces = [d for d in docs
+                  if d["attrs"].get("path") == "/queries.json"]
+        assert traces, "no /queries.json trace reached PIO_TRACE_FILE"
+        t = traces[-1]
+        assert t["attrs"]["server"] == "engine"
+        # spans (read+handle+respond) cover >= 95% of request wall time at
+        # steady state; every request, warm-up included, stays gap-small
+        covs = [sum(s["durationMs"] for s in d["spans"]) / d["durationMs"]
+                for d in traces]
+        assert max(covs) >= 0.95, f"no query reached 95% coverage: {covs}"
+        assert min(covs) >= 0.80, f"large unattributed gap: {covs}"
+        handle = next(s for s in t["spans"] if s["name"] == "http.handle")
+        inner = [s["name"] for s in handle.get("spans", [])]
+        assert "predict.bind" in inner and "predict.serve" in inner
+        assert any(s["name"] == "predict.algorithm"
+                   and s["attrs"].get("algo")
+                   for s in handle["spans"])
+
+    def test_engine_request_id_round_trips(self, deployed):
+        srv, *_ = deployed
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/",
+            headers={"X-Request-ID": "q-7"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["X-Request-ID"] == "q-7"
 
 
 def test_dc_to_json_matches_asdict_on_wire():
@@ -279,6 +401,40 @@ class TestServerPluginSeam:
             srv.stop()
         # stop() runs the plugin's shutdown hook (lifecycle contract)
         assert plugin.started_with is None
+
+    def test_metrics_plugin_matches_builtin_counters(self, pio_home):
+        """The MetricsPlugin exemplar and the built-in instrumentation
+        feed the SAME registry and must agree on totals — proving the
+        plugin path reports identically to the built-in path."""
+        from predictionio_tpu.data.storage import get_storage
+        from predictionio_tpu.obs import get_registry
+        from predictionio_tpu.server.event_server import EventServer
+        from predictionio_tpu.server.plugins import (
+            MetricsPlugin, PluginManager,
+        )
+
+        srv = EventServer(get_storage(), host="127.0.0.1", port=0,
+                          plugins=PluginManager([MetricsPlugin()]))
+        srv.start(block=False)
+        try:
+            for _ in range(3):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/", timeout=10)
+            _req("GET", f"http://127.0.0.1:{srv.port}/nope.json")
+        finally:
+            srv.stop()
+        reg = get_registry()
+        builtin = reg.get("pio_event_requests_total")
+        plugin = reg.get("pio_plugin_requests_total")
+        assert builtin.total() == plugin.total() == 4
+        assert plugin.value(route="GET /", status="200") == 3
+        assert plugin.value(route="GET /nope.json", status="401") == 1
+        # one exposition carries both
+        from tests.test_obs import parse_prometheus
+
+        samples = parse_prometheus(reg.render())
+        assert "pio_plugin_requests_total" in samples
+        assert "pio_event_requests_total" in samples
 
     def test_plugin_failure_does_not_break_requests(self, pio_home,
                                                     monkeypatch):

@@ -200,9 +200,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="fault-injection plan (PIO_FAULTS grammar, e.g. "
+                         "'http.engine:delay:5ms:0.01') to measure tail "
+                         "latency under injected partial failure")
     args = ap.parse_args()
 
     eng, variant, storage, n_users = _setup()
+    if args.faults:
+        # Installed AFTER setup: the plan targets the serving phase under
+        # measurement, not the benchmark's own data load / training.
+        os.environ["PIO_FAULTS"] = args.faults
+        print(json.dumps({"faults": args.faults}))
     from predictionio_tpu.server import EngineServer
 
     srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)

@@ -350,6 +350,31 @@ def cmd_build(args) -> int:
 # pio eventserver / deploy / dashboard
 # --------------------------------------------------------------------------
 
+def _install_drain_handlers(drain) -> None:
+    """SIGTERM/SIGINT → graceful drain: stop accepting, finish in-flight
+    requests, flush the spill journal — a k8s rolling restart must not
+    lose events that were already 202-accepted."""
+    import signal
+
+    def _handler(signum, frame):
+        logger.info("signal %d: draining", signum)
+        try:
+            drain()
+        except Exception:
+            # exit NON-zero with the traceback logged: a failed drain
+            # (e.g. spill flush on a full disk) must not look clean to
+            # the supervisor that sent the signal
+            logger.exception("drain failed")
+            raise SystemExit(1) from None
+        raise SystemExit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _handler)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            continue
+
+
 def cmd_eventserver(args) -> int:
     import time as _time
 
@@ -366,22 +391,28 @@ def cmd_eventserver(args) -> int:
                             plugin_hook=(srv.plugins.header_block
                                          if srv.plugins else None))
         fe.start()
+
+        def _drain_native():
+            fe.stop()
+            srv.drain()
+
+        _install_drain_handlers(_drain_native)
         print(f"Event Server (native frontend) listening on "
               f"{args.ip}:{fe.port} (Ctrl-C to stop)")
         try:
             while True:
                 _time.sleep(3600)
         except KeyboardInterrupt:
-            fe.stop()
-        srv.plugins.stop()
+            _drain_native()
         return 0
+    _install_drain_handlers(srv.drain)
     srv.start(block=False)
     print(f"Event Server listening on {args.ip}:{srv.port} "
           "(Ctrl-C to stop)")
     try:
         srv._thread.join()
     except KeyboardInterrupt:
-        srv.stop()
+        srv.drain()
     return 0
 
 
@@ -428,6 +459,11 @@ def cmd_deploy(args) -> int:
                             fallback=engine_fallback,
                             plugin_hook=(srv.plugins.header_block
                                          if srv.plugins else None))
+        def _drain_native_deploy():
+            fe.stop()
+            srv.plugins.stop()
+
+        _install_drain_handlers(_drain_native_deploy)
         port = fe.start()
         print(f"Native engine frontend on {args.ip}:{port} "
               f"(instance {srv._instance.id}; continuous batching "
@@ -439,6 +475,7 @@ def cmd_deploy(args) -> int:
         fe.stop()
         srv.plugins.stop()
         return 0
+    _install_drain_handlers(srv.stop)
     srv.start(block=False)
     print(f"Engine Server listening on {args.ip}:{srv.port} "
           f"(instance {srv._instance.id}; Ctrl-C to stop)")

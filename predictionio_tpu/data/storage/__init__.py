@@ -29,7 +29,9 @@ from predictionio_tpu.data.storage.base import (  # re-export
     Model,
     Models,
     StorageError,
+    StorageUnavailable,
 )
+from predictionio_tpu.resilience.faults import wrap_events as _wrap_events
 
 __all__ = [
     "Storage",
@@ -39,6 +41,7 @@ __all__ = [
     "App", "Apps", "AccessKey", "AccessKeys", "Channel", "Channels",
     "EngineInstance", "EngineInstances", "EvaluationInstance",
     "EvaluationInstances", "Model", "Models", "Events", "StorageError",
+    "StorageUnavailable",
 ]
 
 
@@ -160,7 +163,8 @@ class _PioServerBackend(_Backend):
         self._client = RemoteClient(
             host, int(port.split(",")[0]),
             secret=source.properties.get("SECRET"),
-            pool_size=int(source.properties.get("CONNECTIONS", "2")))
+            pool_size=int(source.properties.get("CONNECTIONS", "2")),
+            retries=int(source.properties.get("RETRIES", "2")))
 
     def events(self): return self._client.events()
     def apps(self): return self._client.apps()
@@ -217,7 +221,10 @@ class Storage:
 
     # EVENTDATA
     def get_events(self) -> Events:
-        return self._backend_for("EVENTDATA").events()
+        # Fault-injection seam (resilience/faults.py): a no-op passthrough
+        # unless a PIO_FAULTS plan targets storage.* points.  Wrapped per
+        # call so a plan installed mid-process takes effect immediately.
+        return _wrap_events(self._backend_for("EVENTDATA").events())
 
     # METADATA
     def get_apps(self) -> Apps:

@@ -42,6 +42,7 @@ __all__ = [
     "Events",
     "EVENT_ARROW_SCHEMA",
     "StorageError",
+    "StorageUnavailable",
     "normalize_event_table",
     "stamp_event_ids",
 ]
@@ -49,6 +50,15 @@ __all__ = [
 
 class StorageError(RuntimeError):
     pass
+
+
+class StorageUnavailable(StorageError):
+    """The backend is unreachable / timing out — an AVAILABILITY failure,
+    distinct from a bad request: retriable, counted by circuit breakers,
+    and mapped to 503 (or a spill-journal 202) by the servers instead of
+    a client-fault 400."""
+
+    retriable = True
 
 
 # --------------------------------------------------------------------------

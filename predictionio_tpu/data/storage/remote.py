@@ -35,11 +35,20 @@ Optional auth: when the server is started with a shared ``secret``, the
 first message on every connection must be ``{"auth": <secret>}`` — anything
 else closes the connection.  Configure clients with a
 ``PIO_STORAGE_SOURCES_<NAME>_SECRET`` property.
+
+Write idempotency: every mutating request carries a client-generated
+token (``"t"``).  The server keeps a bounded dedup window of recently
+answered write tokens, so a client that loses the REPLY (connection
+killed after the server committed) can resend the same request and get
+the original answer back instead of a duplicate insert.  This is what
+makes remote writes safely retriable — the client retries ALL RPCs with
+jittered backoff, not just reads.
 """
 
 from __future__ import annotations
 
 import base64
+import collections
 import dataclasses
 import datetime as _dt
 import json
@@ -48,6 +57,7 @@ import socket
 import socketserver
 import struct
 import threading
+import uuid
 from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.data.event import DataMap, Event
@@ -55,8 +65,13 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
     EngineInstances, EvaluationInstance, EvaluationInstances, Events, Model,
-    Models, StorageError,
+    Models, StorageError, StorageUnavailable,
 )
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.resilience import current_idempotency_key
+from predictionio_tpu.resilience.deadline import check as _deadline_check
+from predictionio_tpu.resilience.faults import fault_point
+from predictionio_tpu.resilience.policy import RetryPolicy
 
 logger = logging.getLogger(__name__)
 
@@ -124,7 +139,7 @@ def _send(sock: socket.socket, obj: Any) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-def _recv(sock: socket.socket, max_len: int = 0) -> Any:
+def _recv(sock: socket.socket, max_len: Optional[int] = None) -> Any:
     head = b""
     while len(head) < 4:
         chunk = sock.recv(4 - len(head))
@@ -132,8 +147,14 @@ def _recv(sock: socket.socket, max_len: int = 0) -> Any:
             raise ConnectionError("storage server closed the connection")
         head += chunk
     (n,) = struct.unpack(">I", head)
-    if n > (max_len or _MAX_MESSAGE):
-        raise RemoteBackendError("oversized storage reply")
+    # Same frame cap both directions (client AND server): a corrupt or
+    # malicious length prefix must not make either side buffer gigabytes
+    # before failing.  The module-level cap is read at call time so tests
+    # can shrink it.
+    cap = _MAX_MESSAGE if max_len is None else max_len
+    if n > cap:
+        raise RemoteBackendError(
+            f"oversized frame ({n} bytes > cap {cap})")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
@@ -166,14 +187,77 @@ _ALLOWED = {
 _FIND_BATCH = 2000  # events per streamed batch (well under the reply cap)
 
 
+class _DedupWindow:
+    """Bounded token → reply cache shared across connections.
+
+    Holds the last ``capacity`` successful WRITE replies keyed by the
+    client's idempotency token; a resent write whose token is still in
+    the window gets the original reply without re-executing.  Bounded so
+    an adversarial client cannot grow server memory; a token falling out
+    of the window degrades to at-least-once (documented in README).
+
+    ``begin``/``finish`` also track IN-FLIGHT tokens: a retry that
+    arrives while the original dispatch is still executing (write slower
+    than the client's retry backoff) blocks until the original finishes
+    instead of re-executing concurrently — the duplicate-insert race the
+    tokens exist to close."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._replies: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._inflight: Dict[str, threading.Event] = {}
+
+    def begin(self, token: str, wait_s: float = 60.0) -> Optional[Any]:
+        """Claim ``token`` for execution.  Returns the cached reply when
+        the write already committed; None when the caller should run the
+        dispatch (and MUST later call :meth:`finish`).  Waits out an
+        in-flight original first; if it is still running after
+        ``wait_s`` the caller proceeds (bounded at-least-once beats a
+        wedged connection)."""
+        while True:
+            with self._lock:
+                reply = self._replies.get(token)
+                if reply is not None:
+                    self._replies.move_to_end(token)
+                    return reply
+                ev = self._inflight.get(token)
+                if ev is None:
+                    self._inflight[token] = threading.Event()
+                    return None
+            if not ev.wait(wait_s):
+                with self._lock:
+                    # original wedged: steal the claim if still unset
+                    if self._inflight.get(token) is ev:
+                        self._inflight[token] = threading.Event()
+                        return None
+                # else: original finished in the window — loop re-checks
+
+    def finish(self, token: str, reply: Optional[Any]) -> None:
+        """Release the in-flight claim; ``reply`` is cached only when the
+        write succeeded (a transient failure must re-execute on retry)."""
+        with self._lock:
+            if reply is not None:
+                self._replies[token] = reply
+                self._replies.move_to_end(token)
+                while len(self._replies) > self.capacity:
+                    self._replies.popitem(last=False)
+            ev = self._inflight.pop(token, None)
+        if ev is not None:
+            ev.set()
+
+
 class StorageServer:
     """Host a local :class:`~predictionio_tpu.data.storage.Storage` (or any
     object exposing the repository getters) over TCP."""
 
     def __init__(self, storage, host: str = "127.0.0.1", port: int = 0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 dedup_window: int = 4096):
         self.storage = storage
         self.secret = secret
+        self._dedup = _DedupWindow(dedup_window)
         if secret is None and host not in ("127.0.0.1", "localhost", "::1"):
             logger.warning(
                 "Storage server binding %s WITHOUT a shared secret: anything "
@@ -210,7 +294,8 @@ class StorageServer:
                         # so strangers can't make the server buffer/parse
                         # attacker-sized payloads before the secret check.
                         req = _recv(self.request,
-                                    max_len=(1 << 10) if not authed else 0)
+                                    max_len=(1 << 10) if not authed
+                                    else None)
                     except RemoteBackendError:
                         # Oversized pre-auth frame — likely a legitimate
                         # client missing its SECRET property whose first
@@ -252,6 +337,21 @@ class StorageServer:
                             return  # close: no unauthenticated dispatch
                         authed = True
                         continue
+                    token = req.get("t") if isinstance(req, dict) else None
+                    if token:
+                        cached = outer._dedup.begin(token)
+                        if cached is not None:
+                            # Retried write whose first execution
+                            # committed but whose reply was lost: answer
+                            # from the dedup window, do NOT re-execute.
+                            # (begin() also serialized us behind a still-
+                            # running original with the same token.)
+                            try:
+                                _send(self.request, cached)
+                                continue
+                            except (ConnectionError, OSError):
+                                return
+                    reply = None
                     try:
                         result = outer._dispatch(req, cursors)
                         reply = {"ok": _enc(result)}
@@ -261,6 +361,14 @@ class StorageServer:
                         logger.exception("storage RPC failed: %s", req.get("m"))
                         reply = {"err": f"{type(e).__name__}: {e}",
                                  "storage_error": False}
+                    finally:
+                        if token:
+                            # Only successes enter the window: a transient
+                            # failure must re-execute on retry.  Always
+                            # releases the in-flight claim.
+                            outer._dedup.finish(
+                                token,
+                                reply if reply and "ok" in reply else None)
                     try:
                         _send(self.request, reply)
                     except (ConnectionError, OSError):
@@ -289,6 +397,7 @@ class StorageServer:
                 "done": done}
 
     def _dispatch(self, req: Dict, cursors: Dict[int, Any]) -> Any:
+        fault_point("rpc.dispatch")
         repo_name, _, method = req["m"].partition(".")
         args = [_dec(a) for a in req.get("a", [])]
         kwargs = {k: _dec(v) for k, v in req.get("k", {}).items()}
@@ -363,13 +472,28 @@ class RemoteClient:
     ``pool_size`` connections run RPCs concurrently instead of serializing
     every storage call behind one socket lock (round-3 weakness); an open
     scan pins its connection until the cursor drains.
+
+    Every RPC retries on connection failure with jittered backoff
+    (``retry`` policy, ``retries`` attempts after the first): reads are
+    idempotent by nature, and writes carry an idempotency token the
+    server dedups on, so a resend after a lost reply cannot duplicate.
+    Only cursor continuations (``find_next``) stay fail-fast — a
+    half-consumed cursor died with its connection and resuming it
+    transparently could silently skip events.
     """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 secret: Optional[str] = None, pool_size: int = 2):
+                 secret: Optional[str] = None, pool_size: int = 2,
+                 retries: int = 2, retry: Optional[RetryPolicy] = None):
         self.addr = (host, int(port))
         self.timeout = timeout
         self.secret = secret
+        self.retry = retry or RetryPolicy(
+            max_attempts=max(1, int(retries) + 1),
+            base_delay_ms=20.0, max_delay_ms=500.0)
+        self._retries_total = get_registry().counter(
+            "pio_rpc_retries_total",
+            "Remote-storage RPCs resent after a connection failure.")
         self._pool_size = max(1, int(pool_size))
         self._idle: List[_PooledConn] = [_PooledConn(self)
                                          for _ in range(self._pool_size)]
@@ -401,7 +525,9 @@ class RemoteClient:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self.secret is not None:
             _send(s, {"auth": self.secret})
-            reply = _recv(s)
+            # Auth replies are tiny; mirror the server's pre-auth 1 KB cap
+            # so a corrupt/malicious length prefix can't OOM the client.
+            reply = _recv(s, max_len=1 << 10)
             if "err" in reply:
                 s.close()
                 raise RemoteBackendError(
@@ -411,26 +537,40 @@ class RemoteClient:
 
     def _roundtrip(self, conn: _PooledConn, req: Dict, *,
                    retriable: bool, method: str) -> Any:
-        for attempt in (0, 1):
+        attempts = self.retry.max_attempts if retriable else 1
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
             try:
+                _deadline_check(f"storage RPC {method}")
                 sock = conn.ensure()
+                fault_point("rpc.send")
                 _send(sock, req)
+                # rpc.recv faults fire AFTER the request hit the wire —
+                # the server may have committed; this is the lost-reply
+                # case the idempotency tokens exist for.
+                fault_point("rpc.recv")
                 reply = _recv(sock)
                 break
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
                 conn.drop()
-                if attempt or not retriable:
-                    raise RemoteBackendError(
+                last = e
+                if attempt == attempts - 1:
+                    raise StorageUnavailable(
                         f"storage server {self.addr} unreachable "
-                        f"during {method} (write not retried)"
-                        if not retriable else
-                        f"storage server {self.addr} unreachable")
+                        f"during {method}"
+                        + ("" if retriable else " (not retried)")
+                        + f": {e}") from e
+                self._retries_total.inc()
+                self.retry.sleep_backoff(attempt)
             except RemoteBackendError:
                 # Framing-level failure (e.g. oversized reply): the payload
                 # is still on the wire, so the connection is
                 # protocol-desynchronized — never reuse it.
                 conn.drop()
                 raise
+        else:  # pragma: no cover - loop always breaks or raises
+            raise StorageUnavailable(
+                f"storage server {self.addr} unreachable: {last}")
         if "err" in reply:
             if reply.get("storage_error"):
                 raise StorageError(reply["err"])
@@ -440,15 +580,16 @@ class RemoteClient:
     def call(self, method: str, *args, **kwargs) -> Any:
         req = {"m": method, "a": [_enc(a) for a in args],
                "k": {k: _enc(v) for k, v in kwargs.items()}}
-        # Transparent resend is only safe for READS: a write may have
-        # executed server-side before the connection dropped, and
-        # re-sending it would duplicate the insert/update.  Writes fail
-        # fast; the next call reconnects.
         verb = method.split(".", 1)[1] if "." in method else method
-        retriable = verb.startswith(("get", "find"))
+        if not verb.startswith(("get", "find")):
+            # Client-generated idempotency token: the server's dedup
+            # window makes resending this exact request safe even when
+            # the first send committed before the connection died.  The
+            # spill replay pins a persisted token via idempotency_key().
+            req["t"] = current_idempotency_key() or uuid.uuid4().hex
         conn = self._lease()
         try:
-            return self._roundtrip(conn, req, retriable=retriable,
+            return self._roundtrip(conn, req, retriable=True,
                                    method=method)
         finally:
             self._release(conn)

@@ -5,53 +5,148 @@ Python SDK with ``EventClient`` (create_event/get_event/delete_event,
 ``pio import``-style batch) and ``EngineClient`` (send_query).  Same
 surface here, stdlib-only, so reference users can port scripts by
 changing an import.
+
+Resilience additions (README "Resilience"):
+
+- ONE exception surface: every transport failure — HTTP error status,
+  refused connection, DNS failure, timeout — raises
+  :class:`PredictionIOError`.  Connection-level failures carry
+  ``status=None`` and ``retriable=True``; 429/502/503/504 are marked
+  retriable and surface the server's ``Retry-After`` hint as
+  ``retry_after_s``.
+- Opt-in retries: construct a client with ``retries=N`` and retriable
+  failures are retried with jittered exponential backoff
+  (``Retry-After``-aware).  Caveat: the HTTP event API carries no
+  idempotency token, so a retried POST whose first attempt committed
+  before the reply was lost inserts a duplicate — HTTP ingest retries
+  are AT-LEAST-ONCE (that is why they are opt-in).  Exactly-once
+  machinery lives a layer down, on the storage JSON-RPC protocol and
+  the server's spill-replay path.
+- Deadline propagation: ``deadline_ms=...`` stamps every request with
+  ``X-PIO-Deadline-Ms`` so servers can shed work that cannot finish in
+  budget (504) instead of queueing it.
 """
 
 from __future__ import annotations
 
 import datetime as _dt
+import http.client
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
+from predictionio_tpu.resilience.deadline import DEADLINE_HEADER
+from predictionio_tpu.resilience.policy import RetryPolicy
+
 __all__ = ["PredictionIOError", "EventClient", "EngineClient"]
 
 
 class PredictionIOError(RuntimeError):
-    def __init__(self, status: int, message: str):
-        super().__init__(f"HTTP {status}: {message}")
+    """The SDK's one exception surface.
+
+    ``status`` is the HTTP status, or None for connection-level failures
+    (refused, reset, DNS, timeout).  ``retriable`` marks failures a
+    retry could plausibly fix; ``retry_after_s`` carries the server's
+    ``Retry-After`` backoff hint when present.
+    """
+
+    def __init__(self, status: Optional[int], message: str,
+                 retriable: bool = False,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(f"HTTP {status}: {message}" if status is not None
+                         else f"connection error: {message}")
         self.status = status
+        self.retriable = retriable
+        self.retry_after_s = retry_after_s
+
+
+# Transient server statuses a retry could fix (tail-at-scale playbook).
+_RETRIABLE_STATUSES = frozenset({429, 502, 503, 504})
+
+
+def _retry_after_s(headers) -> Optional[float]:
+    try:
+        raw = headers.get("Retry-After") if headers else None
+        return float(raw) if raw else None
+    except (TypeError, ValueError):
+        return None
 
 
 def _request(method: str, url: str, body: Optional[Any] = None,
-             timeout: float = 10.0) -> Any:
+             timeout: float = 10.0, *, retry: Optional[RetryPolicy] = None,
+             deadline_ms: Optional[float] = None) -> Any:
     data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(url, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            payload = resp.read()
-            return json.loads(payload) if payload else None
-    except urllib.error.HTTPError as e:
-        payload = e.read()
+    # One absolute deadline for the WHOLE call, retries included: each
+    # attempt sends the REMAINING budget (the header's documented
+    # meaning) and stops — non-retriably — once it is spent, so retry
+    # backoff can never stretch a 200ms-budget call to seconds.
+    t_end = (time.monotonic() + deadline_ms / 1e3
+             if deadline_ms is not None else None)
+
+    def attempt() -> Any:
+        headers = {"Content-Type": "application/json"}
+        attempt_timeout = timeout
+        if t_end is not None:
+            remaining = (t_end - time.monotonic()) * 1e3
+            if remaining <= 0:
+                raise PredictionIOError(
+                    None, f"deadline exhausted before {method} {url}",
+                    retriable=False)
+            headers[DEADLINE_HEADER] = str(int(remaining))
+            attempt_timeout = min(timeout, remaining / 1e3)
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
         try:
-            msg = json.loads(payload).get("message", "") if payload else ""
-        except json.JSONDecodeError:
-            msg = payload.decode(errors="replace")[:200]
-        raise PredictionIOError(e.code, msg) from None
+            with urllib.request.urlopen(req, timeout=attempt_timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                msg = json.loads(payload).get("message", "") if payload else ""
+            except json.JSONDecodeError:
+                msg = payload.decode(errors="replace")[:200]
+            raise PredictionIOError(
+                e.code, msg, retriable=e.code in _RETRIABLE_STATUSES,
+                retry_after_s=_retry_after_s(e.headers)) from None
+        except (urllib.error.URLError, OSError, TimeoutError,
+                http.client.HTTPException) as e:
+            # URLError wraps socket errors; ConnectionError/timeout can
+            # escape raw; a server dying mid-response raises
+            # http.client exceptions (IncompleteRead, BadStatusLine).
+            # Normalize ALL of them: one exception surface, status None,
+            # retriable.
+            reason = getattr(e, "reason", None) or e
+            raise PredictionIOError(None, str(reason),
+                                    retriable=True) from None
+
+    if retry is not None:
+        return retry.run(attempt,
+                         retriable=lambda e: isinstance(e, PredictionIOError)
+                         and e.retriable,
+                         deadline_ts=t_end)
+    return attempt()
+
+
+def _policy(retries: int) -> Optional[RetryPolicy]:
+    return RetryPolicy(max_attempts=retries + 1) if retries > 0 else None
 
 
 class EventClient:
     """Talks to the Event Server (reference: predictionio.EventClient)."""
 
     def __init__(self, access_key: str, url: str = "http://localhost:7070",
-                 channel: Optional[str] = None, timeout: float = 10.0):
+                 channel: Optional[str] = None, timeout: float = 10.0,
+                 retries: int = 0, deadline_ms: Optional[float] = None):
         self.access_key = access_key
         self.base = url.rstrip("/")
         self.channel = channel
         self.timeout = timeout
+        self.deadline_ms = deadline_ms
+        self.retry = _policy(retries)
 
     def _qs(self, extra: Optional[Mapping[str, Any]] = None) -> str:
         params: Dict[str, Any] = {"accessKey": self.access_key}
@@ -60,6 +155,10 @@ class EventClient:
         if extra:
             params.update({k: v for k, v in extra.items() if v is not None})
         return urllib.parse.urlencode(params, doseq=True)
+
+    def _request(self, method: str, url: str, body: Optional[Any] = None) -> Any:
+        return _request(method, url, body, self.timeout, retry=self.retry,
+                        deadline_ms=self.deadline_ms)
 
     @staticmethod
     def _iso(t) -> Optional[str]:
@@ -84,23 +183,30 @@ class EventClient:
             body["properties"] = dict(properties)
         if event_time is not None:
             body["eventTime"] = self._iso(event_time)
-        out = _request("POST", f"{self.base}/events.json?{self._qs()}", body,
-                       self.timeout)
-        return out["eventId"]
+        out = self._request("POST", f"{self.base}/events.json?{self._qs()}",
+                            body)
+        # 201 carries eventId; a 202 (storage outage, event journaled
+        # server-side) carries the spill token instead.  A token is NOT
+        # an event id — it cannot be passed to get_event/delete_event
+        # (the event's real id is assigned at replay).  Callers that
+        # need to tell them apart should check ``"eventId" in out`` via
+        # create_events()'s per-item dicts or treat a 202 as fire-and-
+        # forget acceptance.
+        return out.get("eventId") or out.get("token")
 
     def create_events(self, events: Sequence[Mapping[str, Any]]) -> List[Dict]:
         """Batch ingest (reference: /batch/events.json, ≤50 per call)."""
-        return _request("POST", f"{self.base}/batch/events.json?{self._qs()}",
-                        list(events), self.timeout)
+        return self._request("POST",
+                             f"{self.base}/batch/events.json?{self._qs()}",
+                             list(events))
 
     def get_event(self, event_id: str) -> Dict[str, Any]:
-        return _request("GET",
-                        f"{self.base}/events/{event_id}.json?{self._qs()}",
-                        timeout=self.timeout)
+        return self._request(
+            "GET", f"{self.base}/events/{event_id}.json?{self._qs()}")
 
     def delete_event(self, event_id: str) -> None:
-        _request("DELETE", f"{self.base}/events/{event_id}.json?{self._qs()}",
-                 timeout=self.timeout)
+        self._request("DELETE",
+                      f"{self.base}/events/{event_id}.json?{self._qs()}")
 
     def find_events(self, **filters) -> List[Dict[str, Any]]:
         """Filters: startTime, untilTime, entityType, entityId, event,
@@ -108,8 +214,7 @@ class EventClient:
         qs = self._qs({k: (str(v).lower() if isinstance(v, bool) else v)
                        for k, v in filters.items()})
         try:
-            return _request("GET", f"{self.base}/events.json?{qs}",
-                            timeout=self.timeout)
+            return self._request("GET", f"{self.base}/events.json?{qs}")
         except PredictionIOError as e:
             if e.status == 404:
                 return []
@@ -134,13 +239,18 @@ class EngineClient:
     """Talks to a deployed engine (reference: predictionio.EngineClient)."""
 
     def __init__(self, url: str = "http://localhost:8000",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, retries: int = 0,
+                 deadline_ms: Optional[float] = None):
         self.base = url.rstrip("/")
         self.timeout = timeout
+        self.deadline_ms = deadline_ms
+        self.retry = _policy(retries)
 
     def send_query(self, query: Mapping[str, Any]) -> Dict[str, Any]:
         return _request("POST", f"{self.base}/queries.json", dict(query),
-                        self.timeout)
+                        self.timeout, retry=self.retry,
+                        deadline_ms=self.deadline_ms)
 
     def status(self) -> Dict[str, Any]:
-        return _request("GET", f"{self.base}/", timeout=self.timeout)
+        return _request("GET", f"{self.base}/", timeout=self.timeout,
+                        retry=self.retry, deadline_ms=self.deadline_ms)

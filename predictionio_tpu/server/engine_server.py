@@ -24,7 +24,11 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.controller import Engine, EngineVariant, RuntimeContext
 from predictionio_tpu.controller.params import bind_params
-from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage import (
+    Storage,
+    StorageUnavailable,
+    get_storage,
+)
 from predictionio_tpu.obs import (
     current_trace_id,
     get_recorder,
@@ -33,9 +37,13 @@ from predictionio_tpu.obs import (
     span,
     trace,
 )
+from predictionio_tpu.resilience import deadline as _deadline
+from predictionio_tpu.resilience.deadline import DeadlineExceeded
+from predictionio_tpu.resilience.faults import fault_point
 from predictionio_tpu.server.http import (
     BaseHandler,
     ThreadingHTTPServer,
+    incoming_deadline_ms,
     incoming_request_id,
     payload_bytes,
 )
@@ -95,6 +103,10 @@ class _QueryMetrics:
             "pio_query_errors_total", "Predict requests that failed.")
         self.latency = self.registry.histogram(
             "pio_query_latency_ms", "Predict request latency.")
+        self.shed = self.registry.counter(
+            "pio_deadline_shed_total",
+            "Requests shed with 504 because their deadline expired.",
+            ("server",))
 
     def record(self, ms: float, ok: bool) -> None:
         self.requests.inc()
@@ -254,6 +266,7 @@ class EngineServer:
 
     def handle(self, method: str, path: str, body: bytes) -> Tuple[int, Any]:
         try:
+            fault_point("http.engine")
             if path == "/" and method == "GET":
                 with self._swap_lock:
                     inst = self._instance
@@ -265,6 +278,17 @@ class EngineServer:
                     "engineInstanceId": inst.id if inst else None,
                     "modelLoadedAt": loaded.isoformat() if loaded else None,
                     "version": __version__,
+                }
+            if path == "/ready" and method == "GET":
+                # Readiness (vs "/" liveness): a model is loaded and
+                # serving — 503 rotates the instance out of the LB pool.
+                with self._swap_lock:
+                    inst = self._instance
+                    serving = self._serving
+                ok = inst is not None and serving is not None
+                return (200 if ok else 503), {
+                    "status": "ready" if ok else "unavailable",
+                    "engineInstanceId": inst.id if inst else None,
                 }
             if path == "/metrics" and method == "GET":
                 # THE process-wide exposition (shared registry render).
@@ -280,10 +304,17 @@ class EngineServer:
             if path == "/queries.json" and method == "POST":
                 t0 = time.perf_counter()
                 try:
+                    # Shed BEFORE binding/predicting: a request whose
+                    # budget is spent must not burn an algorithm pass.
+                    _deadline.check("predict")
                     obj = json.loads(body.decode("utf-8"))
                     result = self.query(obj)
                     self.stats.record((time.perf_counter() - t0) * 1e3, True)
                     return 200, result
+                except DeadlineExceeded as e:
+                    self.stats.shed.inc(server="engine")
+                    self.stats.record((time.perf_counter() - t0) * 1e3, False)
+                    return 504, {"message": str(e)}
                 except (QueryError, json.JSONDecodeError) as e:
                     self.stats.record((time.perf_counter() - t0) * 1e3, False)
                     return 400, {"message": str(e)}
@@ -295,6 +326,15 @@ class EngineServer:
                 threading.Thread(target=self.stop, daemon=True).start()
                 return 200, {"status": "stopping"}
             return 404, {"message": "Not Found"}
+        except DeadlineExceeded as e:
+            self.stats.shed.inc(server="engine")
+            return 504, {"message": str(e)}
+        except (ConnectionError, StorageUnavailable) as e:
+            # Injected faults and dead backends (e.g. reload's storage
+            # reads, which surface as StorageUnavailable once the remote
+            # client exhausts retries) are availability failures: 503,
+            # not a 500 bug report.
+            return 503, {"message": f"Temporarily unavailable: {e}"}
         except Exception:
             logger.exception("engine server internal error")
             return 500, {"message": "Internal server error."}
@@ -314,9 +354,11 @@ class EngineServer:
                     with span("http.read"):
                         length = int(self.headers.get("Content-Length") or 0)
                         body = self.rfile.read(length) if length else b""
-                    with span("http.handle"):
-                        status, payload = server_self.handle(
-                            method, parsed.path, body)
+                    with _deadline.deadline_scope(
+                            incoming_deadline_ms(self.headers)):
+                        with span("http.handle"):
+                            status, payload = server_self.handle(
+                                method, parsed.path, body)
                     troot.set(status=status)
                     extra = server_self.plugins.on_request(
                         f"{method} {parsed.path}", status,

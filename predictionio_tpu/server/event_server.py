@@ -26,8 +26,10 @@ import base64
 import datetime as _dt
 import json
 import logging
+import os
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -37,7 +39,12 @@ from predictionio_tpu.data.json_support import (
     event_to_json,
     parse_iso8601,
 )
-from predictionio_tpu.data.storage import Storage, StorageError, get_storage
+from predictionio_tpu.data.storage import (
+    Storage,
+    StorageError,
+    StorageUnavailable,
+    get_storage,
+)
 from predictionio_tpu.obs import (
     current_trace_id,
     get_recorder,
@@ -46,9 +53,20 @@ from predictionio_tpu.obs import (
     span,
     trace,
 )
+from predictionio_tpu.resilience import idempotency_key
+from predictionio_tpu.resilience import deadline as _deadline
+from predictionio_tpu.resilience.deadline import DeadlineExceeded
+from predictionio_tpu.resilience.faults import fault_point
+from predictionio_tpu.resilience.policy import CircuitBreaker, CircuitOpenError
+from predictionio_tpu.resilience.spill import (
+    ReplayWorker,
+    SpillJournal,
+    resolve_spill_dir,
+)
 from predictionio_tpu.server.http import (
     BaseHandler,
     ThreadingHTTPServer,
+    incoming_deadline_ms,
     incoming_request_id,
     payload_bytes,
 )
@@ -58,6 +76,10 @@ logger = logging.getLogger(__name__)
 __all__ = ["EventServer", "MAX_BATCH_SIZE"]
 
 MAX_BATCH_SIZE = 50  # reference: EventServer batch cap
+
+# Availability failures (vs client faults): these trip the breaker and
+# route to spill/503, never to a 400.
+_UNAVAILABLE = (CircuitOpenError, StorageUnavailable, ConnectionError)
 
 
 class _EventMetrics:
@@ -103,7 +125,10 @@ class EventServer:
     """Owns the HTTP server; one instance per process (reference: main)."""
 
     def __init__(self, storage: Optional[Storage] = None, host: str = "0.0.0.0",
-                 port: int = 7070, plugins=None):
+                 port: int = 7070, plugins=None, *,
+                 breaker: Optional[CircuitBreaker] = None,
+                 spill_dir: Optional[str] = None,
+                 replay_interval_s: Optional[float] = None):
         from predictionio_tpu.server.plugins import PluginManager
 
         self.storage = storage or get_storage()
@@ -115,8 +140,41 @@ class EventServer:
         # within the TTL; auth FAILURES are never cached.
         self._auth_cache: Dict[str, Tuple[float, Any]] = {}
         self._auth_ttl = 5.0
+        # Stale-if-error window: how old a cached key may be and still
+        # authenticate while the metadata store is unreachable.
+        self._auth_stale_max_s = float(
+            os.environ.get("PIO_AUTH_STALE_MAX_S", "300"))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Resilience layer: breaker around every event-store touch, spill
+        # journal + replay worker for write outages, Retry-After hint on
+        # 202/503 answers.  PIO_BREAKER_* / PIO_SPILL_DIR / PIO_RETRY_AFTER_S
+        # document the knobs (README "Resilience").
+        self._breaker = breaker or CircuitBreaker(
+            "eventdata",
+            failure_threshold=int(os.environ.get(
+                "PIO_BREAKER_THRESHOLD", "5")),
+            recovery_time_s=float(os.environ.get(
+                "PIO_BREAKER_RECOVERY_S", "10")),
+            failure_types=(StorageUnavailable, ConnectionError))
+        self.retry_after_s = int(os.environ.get("PIO_RETRY_AFTER_S", "5"))
+        self._shed = self.stats.registry.counter(
+            "pio_deadline_shed_total",
+            "Requests shed with 504 because their deadline expired.",
+            ("server",))
+        self.spill: Optional[SpillJournal] = None
+        self._replay: Optional[ReplayWorker] = None
+        spill_path = resolve_spill_dir(
+            spill_dir, getattr(self.storage.config, "home", None))
+        if spill_path is not None:
+            self.spill = SpillJournal(spill_path)
+            self._replay = ReplayWorker(
+                self.spill, self._replay_insert,
+                interval_s=(replay_interval_s if replay_interval_s is not None
+                            else float(os.environ.get(
+                                "PIO_SPILL_REPLAY_INTERVAL_S", "0.5"))),
+                transient_types=_UNAVAILABLE + (OSError,))
+            self._replay.start()
         # Server plugin seam (reference: EventServerPlugin, SURVEY §5.1):
         # env-discovered request instrumentation, active on the python
         # HTTP path AND the native fallback path.  Started LAST so
@@ -124,6 +182,36 @@ class EventServer:
         self.plugins = (plugins if plugins is not None
                         else PluginManager.from_env("PIO_EVENTSERVER_PLUGINS"))
         self.plugins.start(self)
+
+    # -- spill / replay -----------------------------------------------------
+
+    def _spill_events(self, events_json: List[Any], app_id: int,
+                      channel_id: Optional[int],
+                      token: str) -> Optional[str]:
+        """Durably journal one failed write (single event or whole batch)
+        under the SAME idempotency token the write was issued with — if
+        the "outage" was really a lost reply, the backend committed and
+        replay must dedup against it, not re-insert.  Returns the token,
+        or None when spilling is disabled/broken (caller 503s)."""
+        if self.spill is None:
+            return None
+        try:
+            return self.spill.append(events_json, app_id, channel_id,
+                                     token=token)
+        except (OSError, ValueError):  # ValueError: journal closed itself
+            logger.exception("spill journal write failed")
+            return None
+
+    def _replay_insert(self, record: Dict[str, Any]) -> None:
+        """One journal record → storage, through the breaker (this worker
+        is the half-open prober), re-issuing the ORIGINAL write: same
+        token, same event set, so a dedup-capable backend answers from
+        its window if the original actually committed."""
+        evs = [event_from_json(e) for e in record["events"]]
+        events = self.storage.get_events()
+        with idempotency_key(record["token"]):
+            self._breaker.call(events.insert_batch, evs, record["appId"],
+                               record.get("channelId"))
 
     # -- request-handling core (transport-independent, used by tests) ------
 
@@ -145,7 +233,17 @@ class EventServer:
         hit = self._auth_cache.get(key)
         if hit is not None and now - hit[0] < self._auth_ttl:
             return hit[1], None
-        row = self.storage.get_access_keys().get(key)
+        try:
+            row = self.storage.get_access_keys().get(key)
+        except _UNAVAILABLE:
+            if hit is not None and now - hit[0] < self._auth_stale_max_s:
+                # Stale-if-error: metadata store down but this key was
+                # RECENTLY valid (bounded by PIO_AUTH_STALE_MAX_S so a
+                # long-revoked key cannot ride every future blip) —
+                # degraded ingest (spill) beats turning a metadata
+                # outage into rejected events.
+                return hit[1], None
+            raise
         if row is None:
             return None, 401
         self._auth_cache[key] = (now, row)
@@ -165,7 +263,16 @@ class EventServer:
                body: bytes, headers=None) -> Tuple[int, Any]:
         """Dispatch one request; returns (status, JSON-able payload)."""
         try:
+            fault_point("http.event")
             return self._handle(method, path, params, body, headers)
+        except DeadlineExceeded as e:
+            self._shed.inc(server="event")
+            return 504, {"message": str(e)}
+        except _UNAVAILABLE as e:
+            # Availability failure, NOT a client fault: 503 + Retry-After
+            # (the transport adds the header) so well-behaved clients back
+            # off instead of hammering a dying backend.
+            return 503, {"message": f"Storage temporarily unavailable: {e}"}
         except (EventValidationError, StorageError) as e:
             return 400, {"message": str(e)}
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
@@ -176,9 +283,43 @@ class EventServer:
             logger.exception("Event server internal error")
             return 500, {"message": "Internal server error."}
 
+    def _insert_one(self, ev, key_row, channel_id) -> Tuple[int, Any]:
+        """Single-event ingest through the breaker; degrades to the spill
+        journal (202 + token) when the store is unavailable.  The token
+        is pinned BEFORE the attempt so the spilled record replays the
+        identical write (dedup'd if the original secretly committed).
+        What spills is event_to_json(ev) — the PARSED event, with
+        eventTime/creationTime frozen at ingest — not the raw client
+        body, so replay after a long outage cannot re-stamp times."""
+        events = self.storage.get_events()
+        token = uuid.uuid4().hex
+        try:
+            with idempotency_key(token):
+                event_id = self._breaker.call(
+                    events.insert, ev, key_row.app_id, channel_id)
+            return 201, {"eventId": event_id}
+        except _UNAVAILABLE:
+            spilled = self._spill_events([event_to_json(ev)],
+                                         key_row.app_id, channel_id,
+                                         token)
+            if spilled is None:
+                raise  # no journal → handle() maps to 503
+            return 202, {"message": "Storage unavailable; event journaled "
+                                    "for replay.", "token": spilled}
+
     def _handle(self, method, path, params, body, headers) -> Tuple[int, Any]:
         if path == "/" and method == "GET":
             return 200, {"status": "alive"}
+        if path == "/ready" and method == "GET":
+            # Readiness (vs the "/" liveness ping): storage reachable —
+            # breaker closed.  503 tells the load balancer to rotate this
+            # instance out while it probes recovery.
+            st = self._breaker.state
+            body_ = {"status": "ready" if st == "closed" else "unavailable",
+                     "breaker": st,
+                     "spillQueueDepth": self.spill.depth() if self.spill
+                     else 0}
+            return (200 if st == "closed" else 503), body_
         if path == "/stats.json" and method == "GET":
             return 200, self.stats.snapshot()
         if path == "/metrics" and method == "GET":
@@ -204,8 +345,7 @@ class EventServer:
             ev = event_from_json(obj)
             if key_row.events and ev.event not in key_row.events:
                 return 403, {"message": f"Event {ev.event!r} not allowed by this key."}
-            event_id = events.insert(ev, key_row.app_id, channel_id)
-            return 201, {"eventId": event_id}
+            return self._insert_one(ev, key_row, channel_id)
 
         if path == "/batch/events.json" and method == "POST":
             arr = json.loads(body.decode("utf-8"))
@@ -239,7 +379,8 @@ class EventServer:
                 return 400, {"message": "limit must be >= -1."}
             q["limit"] = None if limit == -1 else limit
             q["reversed"] = params.get("reversed", ["false"])[0].lower() == "true"
-            found = list(events.find(key_row.app_id, channel_id, **q))
+            found = self._breaker.call(
+                lambda: list(events.find(key_row.app_id, channel_id, **q)))
             # Deliberate divergence from upstream (documented in
             # PARITY.md): upstream's event server answers an empty list
             # query with 404 {"message":"Not Found"}; here an empty match
@@ -273,18 +414,19 @@ class EventServer:
                 return 400, {"message": str(e)}
             if key_row.events and ev.event not in key_row.events:
                 return 403, {"message": f"Event {ev.event!r} not allowed by this key."}
-            event_id = events.insert(ev, key_row.app_id, channel_id)
-            return 201, {"eventId": event_id}
+            return self._insert_one(ev, key_row, channel_id)
 
         if path.startswith("/events/") and path.endswith(".json"):
             event_id = path[len("/events/"):-len(".json")]
             if method == "GET":
-                ev = events.get(event_id, key_row.app_id, channel_id)
+                ev = self._breaker.call(
+                    events.get, event_id, key_row.app_id, channel_id)
                 if ev is None:
                     return 404, {"message": "Not Found"}
                 return 200, event_to_json(ev)
             if method == "DELETE":
-                ok = events.delete(event_id, key_row.app_id, channel_id)
+                ok = self._breaker.call(
+                    events.delete, event_id, key_row.app_id, channel_id)
                 return (200, {"message": "Found"}) if ok else (404, {"message": "Not Found"})
 
         return 404, {"message": "Not Found"}
@@ -307,9 +449,19 @@ class EventServer:
                     with span("http.read"):
                         length = int(self.headers.get("Content-Length") or 0)
                         body = self.rfile.read(length) if length else b""
-                    with span("http.handle"):
-                        status, payload = server_self.handle(
-                            method, parsed.path, params, body, self.headers)
+                    with _deadline.deadline_scope(
+                            incoming_deadline_ms(self.headers)):
+                        if _deadline.exceeded():
+                            # Shed BEFORE auth/storage: a request whose
+                            # budget is already gone must not queue.
+                            server_self._shed.inc(server="event")
+                            status, payload = 504, {
+                                "message": "Deadline exceeded."}
+                        else:
+                            with span("http.handle"):
+                                status, payload = server_self.handle(
+                                    method, parsed.path, params, body,
+                                    self.headers)
                     troot.set(status=status)
                     name = None
                     if method == "POST" and parsed.path == "/events.json" \
@@ -326,6 +478,11 @@ class EventServer:
                     extra = server_self.plugins.on_request(
                         f"{method} {parsed.path}", status, ms) \
                         if server_self.plugins else {}
+                    if status in (202, 503):
+                        # Degraded answers carry the backoff hint.
+                        extra = dict(extra or {})
+                        extra.setdefault(
+                            "Retry-After", str(server_self.retry_after_s))
                     with span("http.respond"):
                         data, ctype = payload_bytes(payload)
                         self.respond(status, data, ctype, extra,
@@ -443,11 +600,33 @@ class EventServer:
                 logger.exception("ingest item failed")
                 outs[i] = (500, {"message": "Internal server error."}, None)
         if valid:
+            token = uuid.uuid4().hex  # pinned BEFORE the attempt
             try:
-                ids = events.insert_batch([ev for _, ev in valid],
-                                          key_row.app_id, channel_id)
+                with idempotency_key(token):
+                    ids = self._breaker.call(
+                        events.insert_batch, [ev for _, ev in valid],
+                        key_row.app_id, channel_id)
                 for (i, ev), eid in zip(valid, ids):
                     outs[i] = (201, {"eventId": eid}, ev.event)
+            except _UNAVAILABLE as e:
+                # Mid-batch storage outage: EVERY valid item gets an
+                # explicit answer — spilled (202 + the batch's token)
+                # when the journal is on, 503 when it is not.  Never a
+                # partial silent drop.  The whole batch journals as ONE
+                # record under the token it was attempted with, so the
+                # replay re-issues the identical group insert.
+                spilled = self._spill_events(
+                    [event_to_json(ev) for _, ev in valid],
+                    key_row.app_id, channel_id, token)
+                for i, _ in valid:
+                    outs[i] = ((202, {"message": "Storage unavailable; "
+                                                 "event journaled for "
+                                                 "replay.",
+                                      "token": spilled}, None)
+                               if spilled is not None else
+                               (503, {"message": "Storage temporarily "
+                                                 f"unavailable: {e}"},
+                                None))
             except StorageError as e:
                 for i, _ in valid:
                     outs[i] = (400, {"message": str(e)}, None)
@@ -467,7 +646,22 @@ class EventServer:
 
     def stop(self) -> None:
         if self._httpd:
+            # shutdown() stops accepting; server_close() joins in-flight
+            # handler threads (socketserver block_on_close), so responses
+            # already being written complete before we tear down.
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._replay is not None:
+            self._replay.stop()
+        elif self.spill is not None:
+            self.spill.close()
         self.plugins.stop()
+
+    def drain(self) -> None:
+        """Graceful SIGTERM/SIGINT path: stop accepting, finish in-flight
+        requests, flush the spill journal to disk (it replays on next
+        boot or when storage recovers)."""
+        logger.info("Event server draining (spill depth=%d)",
+                    self.spill.depth() if self.spill else 0)
+        self.stop()

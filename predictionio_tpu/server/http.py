@@ -30,6 +30,7 @@ from http.server import (
 from typing import Any, Dict, Optional, Tuple
 
 from predictionio_tpu.obs.trace import sanitize_trace_id
+from predictionio_tpu.resilience.deadline import DEADLINE_HEADER
 
 logger = logging.getLogger(__name__)
 
@@ -37,8 +38,10 @@ __all__ = [
     "ThreadingHTTPServer",
     "BaseHandler",
     "REQUEST_ID_HEADER",
+    "DEADLINE_HEADER",
     "PROMETHEUS_CTYPE",
     "incoming_request_id",
+    "incoming_deadline_ms",
     "payload_bytes",
 ]
 
@@ -56,6 +59,21 @@ def incoming_request_id(headers) -> Optional[str]:
     if headers is None:
         return None
     return sanitize_trace_id(headers.get(REQUEST_ID_HEADER))
+
+
+def incoming_deadline_ms(headers) -> Optional[float]:
+    """Client-declared time budget (``X-PIO-Deadline-Ms``); None when
+    absent or unparseable — a garbage header must not 500 the request."""
+    if headers is None:
+        return None
+    raw = headers.get(DEADLINE_HEADER)
+    if not raw:
+        return None
+    try:
+        budget = float(raw)
+    except ValueError:
+        return None
+    return budget if budget >= 0 else None
 
 
 def payload_bytes(payload: Any) -> Tuple[bytes, str]:

@@ -1,0 +1,805 @@
+"""Fault-matrix tests for the resilience subsystem.
+
+Tier-1 safe: CPU only, fake clocks injected into RetryPolicy /
+CircuitBreaker (no real sleeps beyond tiny replay-poll ticks), every
+fault cleared after each test.
+"""
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    StorageUnavailable,
+    get_storage,
+)
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.resilience import faults, idempotency_key
+from predictionio_tpu.resilience.deadline import (
+    DeadlineExceeded,
+    deadline_scope,
+    remaining_ms,
+)
+from predictionio_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+)
+from predictionio_tpu.resilience.spill import SpillJournal
+from predictionio_tpu.server.event_server import EventServer
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy (fake sleep — no real waiting)
+# --------------------------------------------------------------------------
+
+
+class _Retriable(RuntimeError):
+    retriable = True
+
+
+def test_retry_policy_exponential_jittered_backoff():
+    slept = []
+    policy = RetryPolicy(max_attempts=4, base_delay_ms=100, multiplier=2.0,
+                         jitter=0.25, sleep=slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise _Retriable("transient")
+        return "ok"
+
+    assert policy.run(flaky) == "ok"
+    assert calls["n"] == 4 and len(slept) == 3
+    for i, s in enumerate(slept):  # seconds; nominal 0.1 * 2^i ± 25%
+        nominal = 0.1 * (2 ** i)
+        assert nominal * 0.74 <= s <= nominal * 1.26
+
+
+def test_retry_policy_deadline_refuses_to_sleep_past_budget():
+    """A backoff (or a server Retry-After hint far larger than any
+    budget) that would sleep past deadline_ts re-raises immediately."""
+    slept = []
+    policy = RetryPolicy(max_attempts=5, base_delay_ms=100, jitter=0,
+                         sleep=slept.append)
+    now = [0.0]
+
+    class Hinted(RuntimeError):
+        retriable = True
+        retry_after_s = 30.0
+
+    with pytest.raises(Hinted):
+        policy.run(lambda: (_ for _ in ()).throw(Hinted()),
+                   deadline_ts=0.2, clock=lambda: now[0])
+    assert slept == []  # 30s hint vs 200ms budget: fail now, don't sleep
+
+    # fits-in-budget backoffs still sleep
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise _Retriable("once")
+        return "ok"
+
+    assert policy.run(flaky, deadline_ts=10.0,
+                      clock=lambda: now[0]) == "ok"
+    assert slept == [0.1]
+
+
+def test_retry_policy_honors_retry_after_and_gives_up():
+    slept = []
+    policy = RetryPolicy(max_attempts=2, base_delay_ms=100,
+                         sleep=slept.append)
+
+    class Hinted(RuntimeError):
+        retriable = True
+        retry_after_s = 7.5
+
+    with pytest.raises(Hinted):
+        policy.run(lambda: (_ for _ in ()).throw(Hinted()))
+    assert slept == [7.5]  # server hint replaces computed backoff
+
+    # non-retriable errors propagate immediately (no sleeps)
+    slept.clear()
+    with pytest.raises(ValueError):
+        policy.run(lambda: (_ for _ in ()).throw(ValueError("client bug")))
+    assert slept == []
+
+
+# --------------------------------------------------------------------------
+# CircuitBreaker (fake clock — the schedule is proved without sleeping)
+# --------------------------------------------------------------------------
+
+
+def test_breaker_opens_half_opens_and_recloses_on_schedule(pio_home):
+    now = [1000.0]
+    br = CircuitBreaker("t", failure_threshold=3, recovery_time_s=30.0,
+                        failure_types=(ConnectionError,),
+                        clock=lambda: now[0])
+    gauge = get_registry().get("pio_breaker_state")
+
+    def boom():
+        raise ConnectionError("down")
+
+    assert br.state == "closed" and gauge.value(breaker="t") == 0
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            br.call(boom)
+    assert br.state == "closed"  # below threshold
+    with pytest.raises(ConnectionError):
+        br.call(boom)
+    assert br.state == "open" and gauge.value(breaker="t") == 2
+    with pytest.raises(CircuitOpenError) as ei:
+        br.call(lambda: "never runs")
+    assert 0 < ei.value.retry_after_s <= 30.0
+
+    now[0] += 29.0
+    assert br.state == "open"  # not yet
+    now[0] += 1.5
+    assert br.state == "half-open" and gauge.value(breaker="t") == 1
+    # failed probe re-opens and restarts the recovery clock
+    with pytest.raises(ConnectionError):
+        br.call(boom)
+    assert br.state == "open"
+    now[0] += 30.5
+    assert br.state == "half-open"
+    assert br.call(lambda: "ok") == "ok"  # successful probe closes
+    assert br.state == "closed" and gauge.value(breaker="t") == 0
+
+
+def test_breaker_ignores_non_availability_errors():
+    br = CircuitBreaker("sel", failure_threshold=1,
+                        failure_types=(ConnectionError,))
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("bad request")))
+    assert br.state == "closed"
+
+
+# --------------------------------------------------------------------------
+# Fault-plan grammar
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    plan = faults.parse_plan(
+        "storage.create:error:0.3,storage.find:delay:200ms,"
+        "rpc.recv:error:1.0:2,slowpoke:delay:1.5s:0.5:7")
+    kinds = [(r.match, r.kind, r.probability, r.delay_ms, r.max_count)
+             for r in plan.rules]
+    assert kinds == [
+        ("storage.create", "error", 0.3, 0.0, None),
+        ("storage.find", "delay", 1.0, 200.0, None),
+        ("rpc.recv", "error", 1.0, 0.0, 2),
+        ("slowpoke", "delay", 0.5, 1500.0, 7),
+    ]
+    for bad in ("nocolon", "x:teleport", "x:delay"):
+        with pytest.raises(ValueError):
+            faults.parse_plan(bad)
+
+
+def test_fault_point_glob_and_max_count(pio_home):
+    naps = []
+    plan = faults.FaultPlan(
+        [faults.FaultRule("storage.*", "error", max_count=2),
+         faults.FaultRule("rpc.send", "delay", delay_ms=30)],
+        sleep=naps.append)
+    faults.install(plan)
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("storage.create")
+    with pytest.raises(faults.FaultInjected):
+        faults.fault_point("storage.find")
+    faults.fault_point("storage.create")  # rule exhausted: no-op
+    faults.fault_point("rpc.send")
+    assert naps == [0.03]
+    assert get_registry().get(
+        "pio_faults_injected_total").total() == 3
+
+
+# --------------------------------------------------------------------------
+# Deadlines
+# --------------------------------------------------------------------------
+
+
+def test_deadline_scope_nests_to_minimum():
+    assert remaining_ms() is None
+    with deadline_scope(60_000):
+        outer = remaining_ms()
+        assert outer is not None and outer <= 60_000
+        with deadline_scope(1_000_000):  # inner CANNOT extend the budget
+            assert remaining_ms() <= 60_000
+        with deadline_scope(10):
+            assert remaining_ms() <= 10
+    assert remaining_ms() is None
+
+
+def _bare_engine_server():
+    """An EngineServer skeleton with no trained instance — resilience
+    routes (/ready, deadline shed) must not require a training run."""
+    from predictionio_tpu.server.engine_server import (
+        EngineServer,
+        _QueryMetrics,
+    )
+
+    srv = EngineServer.__new__(EngineServer)
+    srv.stats = _QueryMetrics()
+    srv._swap_lock = threading.Lock()
+    srv._instance = None
+    srv._serving = None
+    srv._algorithms = []
+    srv._models = []
+    srv._loaded_at = None
+    srv.variant = SimpleNamespace(engine_factory="f", variant_id="v")
+    srv.engine = SimpleNamespace(query_class=None)
+    return srv
+
+
+class _MustNotRun:
+    def supplement(self, q):  # pragma: no cover - the test asserts this
+        raise AssertionError("algorithm path ran past an expired deadline")
+
+    serve = supplement
+    predict = supplement
+
+
+def test_deadline_exceeded_sheds_before_the_algorithm(pio_home):
+    srv = _bare_engine_server()
+    srv._instance = SimpleNamespace(id="i1")
+    srv._serving = _MustNotRun()
+    srv._algorithms = [_MustNotRun()]
+    srv._models = [None]
+    with deadline_scope(0):
+        status, payload = srv.handle("POST", "/queries.json",
+                                     json.dumps({"q": 1}).encode())
+    assert status == 504
+    assert "deadline" in payload["message"].lower()
+    assert get_registry().get("pio_deadline_shed_total").value(
+        server="engine") == 1
+    # with budget left the same request executes (and here, explodes)
+    status, _ = srv.handle("POST", "/queries.json", b"{}")
+    assert status == 500
+
+
+def test_engine_ready_reflects_model_load(pio_home):
+    srv = _bare_engine_server()
+    status, payload = srv.handle("GET", "/ready", b"")
+    assert (status, payload["status"]) == (503, "unavailable")
+    srv._instance = SimpleNamespace(id="i1")
+    srv._serving = object()
+    status, payload = srv.handle("GET", "/ready", b"")
+    assert (status, payload["engineInstanceId"]) == (200, "i1")
+
+
+def test_engine_maps_dead_storage_to_503(pio_home):
+    """A remote storage backend that exhausted its retries surfaces as
+    StorageUnavailable — an availability 503, not a 500 bug report."""
+    srv = _bare_engine_server()
+
+    class DeadStorage:
+        def get_engine_instances(self):
+            raise StorageUnavailable("storage server unreachable")
+
+    srv.storage = DeadStorage()
+    srv.requested_instance_id = "i1"
+    status, payload = srv.handle("POST", "/reload", b"")
+    assert status == 503
+    assert "unavailable" in payload["message"].lower()
+
+
+# --------------------------------------------------------------------------
+# Event server degradation (fault matrix)
+# --------------------------------------------------------------------------
+
+
+def _event_stack(pio_home, **server_kw):
+    storage = get_storage()
+    app_id = storage.get_apps().insert(App(id=None, name="resil"))
+    storage.get_events().init(app_id)
+    key = storage.get_access_keys().insert(AccessKey(key="", app_id=app_id))
+    srv = EventServer(storage=storage, host="127.0.0.1", port=0, **server_kw)
+    return srv, key, storage, app_id
+
+
+def _post(srv, key, path, payload):
+    return srv.handle("POST", path, {"accessKey": [key]},
+                      json.dumps(payload).encode())
+
+
+def test_mid_batch_outage_answers_every_item(pio_home):
+    """(a) A storage outage mid-batch yields explicit per-item 503s (spill
+    disabled) — never a partial silent drop, and the invalid item still
+    gets its own 400."""
+    srv, key, *_ = _event_stack(pio_home, spill_dir="off")
+    try:
+        batch = [
+            {"event": "buy", "entityType": "user", "entityId": "u0"},
+            {"event": "buy", "entityType": "user", "entityId": "u1"},
+            {"entityType": "user", "entityId": "broken"},  # no "event"
+            {"event": "buy", "entityType": "user", "entityId": "u2"},
+        ]
+        faults.install("storage.create:error:1.0")
+        status, results = _post(srv, key, "/batch/events.json", batch)
+        assert status == 200
+        assert [r["status"] for r in results] == [503, 503, 400, 503]
+        # single-event POST degrades to a plain 503 without a journal
+        status, _ = _post(srv, key, "/events.json",
+                          {"event": "buy", "entityType": "user",
+                           "entityId": "u9"})
+        assert status == 503
+        faults.clear()
+        assert list(get_storage().get_events().find(1)) == []
+    finally:
+        srv.stop()
+
+
+def test_full_outage_spills_200_events_then_replays_exactly_once(pio_home):
+    """(b) + acceptance: a 200-event ingest during a total storage outage
+    loses nothing — every event is journaled with 202, and after the
+    fault clears the replay thread lands exactly 200 events (no dupes),
+    with pio_spill_queue_depth draining to 0."""
+    breaker = CircuitBreaker(
+        "eventdata", failure_threshold=2, recovery_time_s=0.04,
+        failure_types=(StorageUnavailable, ConnectionError))
+    srv, key, storage, app_id = _event_stack(
+        pio_home, breaker=breaker, replay_interval_s=0.02)
+    try:
+        faults.install("storage.create:error:1.0")
+        statuses = []
+        for start in range(0, 200, 50):
+            batch = [{"event": "view", "entityType": "user",
+                      "entityId": f"u{start + i}"} for i in range(50)]
+            status, results = _post(srv, key, "/batch/events.json", batch)
+            assert status == 200
+            statuses.extend(r["status"] for r in results)
+        assert statuses == [202] * 200
+        assert srv.spill.depth() == 200
+        assert breaker.state == "open"  # outage tripped it
+
+        faults.clear()
+        deadline = time.monotonic() + 30
+        while srv.spill.depth() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.spill.depth() == 0
+        assert get_registry().get("pio_spill_queue_depth").value() == 0
+        assert get_registry().get("pio_spill_replayed_total").value() == 200
+
+        events = list(storage.get_events().find(app_id))
+        assert len(events) == 200  # exactly once: no loss, no duplicates
+        assert {e.entity_id for e in events} == {f"u{i}" for i in range(200)}
+        assert breaker.state == "closed"  # replay worker probed it closed
+    finally:
+        srv.stop()
+
+
+def test_spill_journal_survives_restart(pio_home, tmp_path):
+    j = SpillJournal(tmp_path / "sp")
+    for i in range(3):
+        j.append([{"event": "view", "entityType": "u", "entityId": str(i)}],
+                 app_id=1, channel_id=None)
+    j.mark_replayed(j.peek(1))
+    j.close()
+    j2 = SpillJournal(tmp_path / "sp")  # crash-restart: offset persisted
+    assert j2.depth() == 2
+    assert [r["events"][0]["entityId"] for r in j2.peek(10)] == ["1", "2"]
+    j2.mark_replayed(j2.peek(10))
+    assert j2.depth() == 0
+    j2.close()
+
+
+def test_spill_journal_truncates_torn_tail(pio_home, tmp_path):
+    """A crash mid-append leaves a partial trailing line; it was never
+    202-acked, so reopening drops it instead of killing the replayer."""
+    j = SpillJournal(tmp_path / "sp")
+    j.append([{"event": "view", "entityType": "u", "entityId": "whole"}],
+             app_id=1, channel_id=None)
+    j.close()
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"token": "t", "appId": 1, "eve')  # torn mid-write
+    j2 = SpillJournal(tmp_path / "sp")
+    assert j2.depth() == 1
+    recs = j2.peek(10)
+    assert [r["events"][0]["entityId"] for r in recs] == ["whole"]
+    j2.mark_replayed(recs)
+    assert j2.depth() == 0
+    j2.close()
+
+
+def test_spill_journal_clamps_stale_offset(pio_home, tmp_path):
+    """A crash between drain-truncate and offset reset must not leave an
+    offset pointing past the (now shorter) journal — that would make
+    peek() skip every future record forever."""
+    j = SpillJournal(tmp_path / "sp")
+    j.append([{"event": "view"}], app_id=1, channel_id=None)
+    j.close()
+    (tmp_path / "sp" / "spill.offset").write_text("999")  # stale
+    j2 = SpillJournal(tmp_path / "sp")
+    assert j2.depth() == 0  # clamped, not wedged
+    j2.append([{"event": "later"}], app_id=1, channel_id=None)
+    assert j2.depth() == 1
+    assert [r["events"][0]["event"] for r in j2.peek(10)] == ["later"]
+    j2.close()
+
+
+def test_spilled_events_freeze_ingest_timestamps(pio_home):
+    """The journal stores the PARSED event (event_to_json), so an event
+    POSTed without an explicit eventTime keeps its ingest-time stamp
+    through a replay hours later, instead of being re-stamped."""
+    srv, key, *_ = _event_stack(pio_home, replay_interval_s=3600)
+    try:
+        faults.install("storage.create:error:1.0")
+        status, _ = _post(srv, key, "/events.json",
+                          {"event": "view", "entityType": "u",
+                           "entityId": "x"})  # note: no eventTime
+        assert status == 202
+        rec = srv.spill.peek(1)[0]
+        assert rec["events"][0]["eventTime"]  # frozen at ingest
+        assert rec["events"][0]["creationTime"]
+    finally:
+        srv.stop()
+
+
+def test_poison_record_dead_letters_instead_of_wedging(pio_home, tmp_path):
+    """(replay liveness) A record that fails replay with a PERMANENT
+    error is dead-lettered so the records behind it still drain;
+    transient failures pause the drain without advancing."""
+    from predictionio_tpu.resilience.spill import ReplayWorker
+
+    j = SpillJournal(tmp_path / "sp")
+    for name in ("ok1", "poison", "ok2"):
+        j.append([{"event": name}], app_id=1, channel_id=None)
+    landed = []
+
+    def insert(rec):
+        name = rec["events"][0]["event"]
+        if name == "poison":
+            raise ValueError("schema drift")
+        landed.append(name)
+
+    worker = ReplayWorker(j, insert, interval_s=3600)
+    assert worker.drain_once() == 2
+    assert landed == ["ok1", "ok2"]
+    assert j.depth() == 0
+    assert j.dead_path.exists()
+    dead = [json.loads(line) for line in
+            j.dead_path.read_text().splitlines()]
+    assert [d["events"][0]["event"] for d in dead] == ["poison"]
+    assert get_registry().get("pio_spill_dead_lettered_total").value() == 1
+
+    # transient failure: nothing advances, nothing dead-letters
+    j.append([{"event": "later"}], app_id=1, channel_id=None)
+
+    def down(rec):
+        raise ConnectionError("storage down")
+
+    assert ReplayWorker(j, down, interval_s=3600).drain_once() == 0
+    assert j.depth() == 1
+    j.close()
+
+
+def test_reads_shed_503_while_breaker_open(pio_home):
+    srv, key, *_ = _event_stack(pio_home, spill_dir="off")
+    try:
+        faults.install("storage.*:error:1.0")
+        for _ in range(srv._breaker.failure_threshold):
+            status, _ = srv.handle("GET", "/events.json",
+                                   {"accessKey": [key]}, b"")
+            assert status == 503
+        faults.clear()
+        # breaker open: sheds WITHOUT touching storage, readiness flips
+        assert srv._breaker.state == "open"
+        status, _ = srv.handle("GET", "/events.json",
+                               {"accessKey": [key]}, b"")
+        assert status == 503
+        status, body = srv.handle("GET", "/ready", {}, b"")
+        assert (status, body["breaker"]) == (503, "open")
+    finally:
+        srv.stop()
+
+
+def test_event_server_deadline_header_sheds_over_http(pio_home):
+    srv, key, *_ = _event_stack(pio_home)
+    srv.start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}",
+            data=b'{"event":"view","entityType":"u","entityId":"x"}',
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Deadline-Ms": "0"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 504
+        assert get_registry().get("pio_deadline_shed_total").value(
+            server="event") == 1
+        # a generous budget flows through to a normal 201
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}",
+            data=b'{"event":"view","entityType":"u","entityId":"x"}',
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Deadline-Ms": "30000"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+    finally:
+        srv.stop()
+
+
+def test_degraded_202_and_503_carry_retry_after(pio_home):
+    srv, key, *_ = _event_stack(pio_home, replay_interval_s=3600)
+    srv.start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        faults.install("storage.create:error:1.0")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/events.json?accessKey={key}",
+            data=b'{"event":"view","entityType":"u","entityId":"x"}',
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 202
+            assert resp.headers["Retry-After"] == str(srv.retry_after_s)
+            assert json.loads(resp.read())["token"]
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------------
+# RemoteClient: retriable writes via idempotency tokens
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def remote_events(pio_home):
+    from predictionio_tpu.data.storage import memory as m
+    from predictionio_tpu.data.storage.remote import (
+        RemoteClient,
+        StorageServer,
+    )
+
+    class Hosted:
+        def __init__(self):
+            self._events = m.MemoryEvents()
+
+        def get_events(self):
+            return self._events
+
+        def __getattr__(self, name):
+            if name.startswith("get_"):
+                return lambda: None
+            raise AttributeError(name)
+
+    srv = StorageServer(Hosted(), host="127.0.0.1", port=0)
+    srv.start()
+    client = RemoteClient("127.0.0.1", srv.port)
+    repo = client.events()
+    repo.init(1)
+    yield repo, client
+    client.close()
+    srv.stop()
+
+
+def test_write_retried_after_lost_reply_dedups(remote_events):
+    """Acceptance: kill the connection after the server commits — the
+    retried write carries the same idempotency token and the server's
+    dedup window answers it without re-inserting (count stays 1)."""
+    from predictionio_tpu.data.event import DataMap, Event
+
+    repo, _client = remote_events
+    # rpc.recv fires AFTER the request hit the wire: the server commits,
+    # the client never sees the reply.  Exactly one injection.
+    faults.install("rpc.recv:error:1.0:1")
+    eid = repo.insert(Event(event="rate", entity_type="user",
+                            entity_id="u1", properties=DataMap({})), 1)
+    faults.clear()
+    assert eid
+    events = list(repo.find(1))
+    assert len(events) == 1 and events[0].event_id == eid
+    assert get_registry().get("pio_rpc_retries_total").value() >= 1
+
+
+def test_pinned_idempotency_token_spans_connections(remote_events):
+    """The spill replay pins its persisted token: issuing the SAME insert
+    twice under one token lands exactly one event."""
+    from predictionio_tpu.data.event import DataMap, Event
+
+    repo, _client = remote_events
+    ev = Event(event="buy", entity_type="user", entity_id="u2",
+               properties=DataMap({}))
+    with idempotency_key("tok-123"):
+        first = repo.insert(ev, 1)
+    with idempotency_key("tok-123"):
+        second = repo.insert(ev, 1)
+    assert first == second
+    assert len(list(repo.find(1))) == 1
+
+
+def test_dedup_window_serializes_inflight_retries():
+    """A retry arriving while the ORIGINAL write is still executing must
+    wait for it and take the cached reply — not re-execute concurrently
+    (the duplicate-insert race for writes slower than the backoff)."""
+    from predictionio_tpu.data.storage.remote import _DedupWindow
+
+    w = _DedupWindow()
+    assert w.begin("t1") is None  # original claims the token
+    got = []
+    th = threading.Thread(target=lambda: got.append(w.begin("t1")))
+    th.start()
+    time.sleep(0.05)
+    assert got == []  # retry parked behind the in-flight original
+    w.finish("t1", {"ok": 41})
+    th.join(5)
+    assert got == [{"ok": 41}]
+    # failed originals are NOT cached: the retry re-executes
+    assert w.begin("t2") is None
+    w.finish("t2", None)
+    assert w.begin("t2") is None
+    w.finish("t2", {"ok": 42})
+
+
+def test_exhausted_retries_surface_storage_unavailable(pio_home):
+    from predictionio_tpu.data.storage.remote import RemoteClient
+
+    # nothing listens on this port; tiny backoff keeps the test fast
+    client = RemoteClient("127.0.0.1", 1, timeout=0.2,
+                          retry=RetryPolicy(max_attempts=2, base_delay_ms=1))
+    with pytest.raises(StorageUnavailable):
+        client.call("events.insert", None, 1)
+    client.close()
+
+
+def test_recv_rejects_corrupt_length_prefix():
+    from predictionio_tpu.data.storage import remote as r
+
+    a, b = socket.socketpair()
+    try:
+        # 4 GB length prefix: the client must refuse BEFORE buffering
+        b.sendall(struct.pack(">I", 0xFFFFFFFF))
+        with pytest.raises(r.RemoteBackendError, match="oversized"):
+            r._recv(a)
+        # and the tighter auth-time cap rejects merely-large frames too
+        a2, b2 = socket.socketpair()
+        b2.sendall(struct.pack(">I", 2048) + b"x" * 2048)
+        with pytest.raises(r.RemoteBackendError, match="oversized"):
+            r._recv(a2, max_len=1 << 10)
+        a2.close(), b2.close()
+    finally:
+        a.close(), b.close()
+
+
+# --------------------------------------------------------------------------
+# SDK: one exception surface
+# --------------------------------------------------------------------------
+
+
+def test_sdk_normalizes_connection_errors():
+    from predictionio_tpu.sdk import EventClient, PredictionIOError
+
+    c = EventClient("k", "http://127.0.0.1:1", timeout=0.2)  # refused
+    with pytest.raises(PredictionIOError) as ei:
+        c.set_user("u1")
+    assert ei.value.status is None
+    assert ei.value.retriable is True
+
+
+def test_sdk_retries_connection_failures_with_backoff():
+    from predictionio_tpu.sdk import EventClient, PredictionIOError
+
+    slept = []
+    c = EventClient("k", "http://127.0.0.1:1", timeout=0.2, retries=2)
+    c.retry = RetryPolicy(max_attempts=3, base_delay_ms=10,
+                          sleep=slept.append)
+    with pytest.raises(PredictionIOError):
+        c.set_user("u1")
+    assert len(slept) == 2  # three attempts, two backoffs
+
+
+def test_sdk_deadline_bounds_total_retry_time():
+    """The client-declared budget covers the WHOLE call, retries and
+    backoff included — each attempt sends the REMAINING budget and the
+    call stops (non-retriably) once it is spent."""
+    from predictionio_tpu.sdk import EventClient, PredictionIOError
+
+    c = EventClient("k", "http://127.0.0.1:1", timeout=0.2,
+                    retries=10, deadline_ms=60)
+    c.retry = RetryPolicy(max_attempts=11, base_delay_ms=30, jitter=0)
+    t0 = time.monotonic()
+    with pytest.raises(PredictionIOError):
+        c.set_user("u1")
+    # the budget stops the run after ~2 of the 10 allowed 30ms backoffs
+    # (the policy refuses to sleep past deadline_ts) — nowhere near the
+    # ~300ms of full retries, let alone unbounded Retry-After sleeps
+    assert time.monotonic() - t0 < 1.0
+    # and a budget already spent before the first attempt fails fast,
+    # non-retriably, without touching the network
+    c0 = EventClient("k", "http://127.0.0.1:1", timeout=0.2,
+                     deadline_ms=0)
+    with pytest.raises(PredictionIOError) as ei:
+        c0.set_user("u1")
+    assert "deadline exhausted" in str(ei.value)
+    assert ei.value.retriable is False
+
+
+def test_sdk_normalizes_server_death_mid_response():
+    """A server dying mid-body raises http.client.IncompleteRead, which
+    must surface as PredictionIOError like every other transport fault."""
+    from predictionio_tpu.sdk import EventClient, PredictionIOError
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def truncating_server():
+        conn, _ = lsock.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 100\r\n\r\nshort")
+        conn.close()
+
+    th = threading.Thread(target=truncating_server, daemon=True)
+    th.start()
+    try:
+        c = EventClient("k", f"http://127.0.0.1:{port}", timeout=5)
+        with pytest.raises(PredictionIOError) as ei:
+            c.set_user("u1")
+        assert ei.value.status is None and ei.value.retriable is True
+    finally:
+        lsock.close()
+
+
+def test_spill_append_failure_rolls_back_cleanly(pio_home, tmp_path,
+                                                 monkeypatch):
+    """A failed fsync must not leave a half-accounted line that skews
+    the position-based replay for records acked AFTER it."""
+    import predictionio_tpu.resilience.spill as spill_mod
+
+    j = SpillJournal(tmp_path / "sp")
+    real_fsync = os.fsync
+    monkeypatch.setattr(spill_mod.os, "fsync",
+                        lambda fd: (_ for _ in ()).throw(OSError("ENOSPC")))
+    with pytest.raises(OSError):
+        j.append([{"event": "lost"}], app_id=1, channel_id=None)
+    monkeypatch.setattr(spill_mod.os, "fsync", real_fsync)
+    assert j.depth() == 0  # rolled back: the 503'd write left no trace
+    j.append([{"event": "kept"}], app_id=1, channel_id=None)
+    recs = j.peek(10)
+    assert [r["events"][0]["event"] for r in recs] == ["kept"]
+    j.mark_replayed(recs)
+    assert j.depth() == 0
+    j.close()
+
+
+def test_spill_journal_second_instance_diverts(pio_home, tmp_path):
+    """The journal format assumes one appender: a second instance on the
+    same directory must divert to a private subdir instead of truncating
+    or double-replaying under the first."""
+    a = SpillJournal(tmp_path / "sp")
+    b = SpillJournal(tmp_path / "sp")
+    assert b.dir != a.dir and b.dir.parent == a.dir
+    a.append([{"event": "av"}], app_id=1, channel_id=None)
+    b.append([{"event": "bv"}], app_id=1, channel_id=None)
+    assert [r["events"][0]["event"] for r in a.peek(10)] == ["av"]
+    assert [r["events"][0]["event"] for r in b.peek(10)] == ["bv"]
+    a.close()
+    b.close()
+    c = SpillJournal(tmp_path / "sp")  # lock released: adopts the main dir
+    assert c.dir == a.dir and c.depth() == 1
+    c.close()

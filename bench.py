@@ -496,7 +496,8 @@ def phase_profile(inputs, iters=4):
 
 
 def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
-                     run_window, barrier, dev_rate, n_windows=6, window=8):
+                     run_window, barrier, dev_rate, n_windows=6, window=8,
+                     model=None):
     """Shared feeder-in-the-loop measurement (two-tower + DLRM).
 
     Returns (feeder_examples_per_sec, pipeline_examples_per_sec,
@@ -504,10 +505,17 @@ def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
     then the overlapped feeder→H2D→step loop — ``stack_window`` turns a
     list of host batches into device arrays, ``run_window`` dispatches
     ``window`` fused steps (async) and returns the carried state,
-    ``barrier`` forces completion of the final state."""
+    ``barrier`` forces completion of the final state.
+
+    The loop runs under a ``PipelineProbe`` (one probe "step" = one
+    window), so the round artifact carries the per-model host_wait / h2d
+    / device_wait decomposition of the measured gap — the timeline block
+    ``tools/attribute_gap.py`` attributes."""
+    import itertools
     import tempfile
 
     from predictionio_tpu.native.feeder import EventFeeder, write_cache
+    from predictionio_tpu.obs import PipelineProbe
 
     with tempfile.TemporaryDirectory(prefix=prefix) as td:
         cache = write_cache(f"{td}/c.piof", **cache_kwargs)
@@ -523,21 +531,32 @@ def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
             fd.close()
 
         fd2 = EventFeeder(cache, bs, seed=2)
+        probe = PipelineProbe(model or prefix.strip("_"))
         try:
+            def windows():
+                while True:
+                    batches = []
+                    while len(batches) < window:
+                        b = next_batch(fd2)
+                        # epoch wrap (None) and ragged tails are skipped
+                        # to keep the window's shapes static
+                        if b is not None and len(b[0]) == bs:
+                            batches.append(b)
+                    yield batches
+
             state, done = None, 0
             t0 = time.perf_counter()
-            for _ in range(n_windows):
-                batches = []
-                while len(batches) < window:
-                    b = next_batch(fd2)
-                    # epoch wrap (None) and ragged tails are skipped to
-                    # keep the window's shapes static
-                    if b is not None and len(b[0]) == bs:
-                        batches.append(b)
+            for batches in probe.iter_host(
+                    itertools.islice(windows(), n_windows)):
+                with probe.h2d():
+                    arrays = stack_window(batches)
+                probe.sync()  # wait on window N-1: its state carries in
                 # async dispatch: the device chews this window while the
                 # feeder assembles the next one
-                state = run_window(state, stack_window(batches), window)
+                state = run_window(state, arrays, window)
+                probe.dispatched(state, examples=window * bs)
                 done += window * bs
+            probe.finish()
             barrier(state)
             dt = time.perf_counter() - t0
         finally:
@@ -631,7 +650,8 @@ def tpu_era_bench():
                  item_ids=rng.integers(0, cfg.n_items, n_rows)),
             lambda fd: fd.next_batch(), tt_stack, tt_run,
             lambda s: float(jnp.sum(s[0]["user_embed"][0])),
-            out["two_tower_examples_per_sec_per_chip"])
+            out["two_tower_examples_per_sec_per_chip"],
+            model="two_tower")
         out["two_tower_feeder_examples_per_sec"] = feeder_rate
         out["two_tower_pipeline_examples_per_sec"] = pipe
         out["two_tower_pipeline_gap_pct"] = gap
@@ -704,7 +724,8 @@ def tpu_era_bench():
             lambda fd: fd.next_batch_cats(), dl_stack, dl_run,
             lambda s: float(jnp.sum(
                 jax.tree_util.tree_leaves(s[0])[0]).astype(jnp.float32)),
-            out["dlrm_examples_per_sec_per_chip"])
+            out["dlrm_examples_per_sec_per_chip"],
+            model="dlrm")
         out["dlrm_feeder_examples_per_sec"] = feeder_rate
         out["dlrm_pipeline_examples_per_sec"] = pipe
         out["dlrm_pipeline_gap_pct"] = gap
@@ -1029,6 +1050,13 @@ def main():
     if coo is not None and "scan_to_coo_s" in store:
         store["e2e_scan_prep_train_s"] = round(
             store["scan_to_coo_s"] + train["e2e_full_train_s"], 2)
+    # Per-model step-timeline summaries (host_wait/h2d/device_wait) from
+    # the probed feeder-in-the-loop runs above: the pipeline-gap
+    # attribution input for tools/attribute_gap.py.
+    from predictionio_tpu.obs import get_timeline
+
+    tl = get_timeline()
+    timeline = {m: tl.summary(m) for m in tl.models()}
     value = train.pop("value")
     # Self-baseline: speedup over round 3's measured per-iteration time at
     # the same shape on the same chip (reproducible, unlike the retired
@@ -1044,6 +1072,7 @@ def main():
         "train": train,
         "store": store,
         "tpu_era": tpu_era,
+        "timeline": timeline,
         "serving": serving,
         "ingest": ingest,
     }))

@@ -10,6 +10,13 @@ frontends over real HTTP with concurrent closed-loop clients:
 
 Usage: python bench_serving.py [--clients 16] [--requests 2000]
 Prints one JSON line per frontend.
+
+With ``--faults SPEC`` (PIO_FAULTS grammar, e.g.
+``http.engine:delay:5ms:0.05``) the python frontend is driven TWICE on
+the same server — clean, then with the fault plan installed — and the
+line carries ``clean`` / ``faulted`` blocks plus the p99 delta, so a
+round artifact finally records tail latency under injected partial
+failure (ROADMAP resilience follow-on (c)).
 """
 
 import argparse
@@ -207,19 +214,32 @@ def main():
     args = ap.parse_args()
 
     eng, variant, storage, n_users = _setup()
-    if args.faults:
-        # Installed AFTER setup: the plan targets the serving phase under
-        # measurement, not the benchmark's own data load / training.
-        os.environ["PIO_FAULTS"] = args.faults
-        print(json.dumps({"faults": args.faults}))
     from predictionio_tpu.server import EngineServer
 
     srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
     srv.start()
     res = _drive(srv.port, n_users, args.clients, args.requests)
     res.update(_scrape_server_hist(srv.port))
-    srv.stop()
-    print(json.dumps({"frontend": "python", **res}))
+    if args.faults:
+        # Clean drive above, faulted drive below, SAME server/model:
+        # the pair is the tail-latency-under-partial-failure record.
+        # Installed AFTER setup+clean so the plan targets only the
+        # faulted serving phase, not data load / training / baseline.
+        os.environ["PIO_FAULTS"] = args.faults
+        faulted = _drive(srv.port, n_users, args.clients, args.requests)
+        # Uninstall before the native section below: its line carries no
+        # faults marker, so it must actually run clean.
+        os.environ.pop("PIO_FAULTS", None)
+        srv.stop()
+        delta = {}
+        for k in ("p50_ms", "p99_ms"):
+            if k in res and k in faulted:
+                delta[f"{k}_delta"] = round(faulted[k] - res[k], 2)
+        print(json.dumps({"frontend": "python", "faults": args.faults,
+                          "clean": res, "faulted": faulted, **delta}))
+    else:
+        srv.stop()
+        print(json.dumps({"frontend": "python", **res}))
 
     try:
         from predictionio_tpu.native.frontend import NativeFrontend

@@ -14,6 +14,7 @@ Appendix A's CLI verb list.  Verbs:
     pio eval    <EvaluationClass> <EngineParamsGeneratorClass>
     pio eventserver --port 7070        (added with the server layer)
     pio deploy  --engine-json ... --port 8000
+    pio profile [--url http://HOST:7071] [--duration-ms N]
 
 Where the reference's `pio train`/`pio deploy` shell out to spark-submit,
 these run the workflow in-process — there is no cluster-manager boundary on
@@ -71,11 +72,42 @@ def cmd_status(args) -> int:
         devs = jax.devices()
         print(f"devices: {len(devs)} x {devs[0].platform if devs else '-'}"
               f" ({devs[0].device_kind if devs else '-'})")
+        _print_device_memory()
     except Exception as e:  # TPU tunnel may be down; status should still work
         print(f"devices: unavailable ({e})")
     _print_metrics_snapshot(getattr(args, "metrics_url", None))
     print("(sanity check OK)")
     return 0
+
+
+def _print_device_memory() -> None:
+    """Device-memory snapshot (obs.runtime sampler): live allocator stats
+    for this process, plus any per-train-run peaks a local run recorded.
+    A remote server's peaks arrive via --metrics-url (the sampler exports
+    pio_device_mem_bytes / pio_device_mem_peak_bytes there)."""
+    from predictionio_tpu.obs import get_memory_sampler
+
+    sampler = get_memory_sampler()
+    try:
+        sample = sampler.sample_once()
+    except Exception as e:
+        print(f"device memory: unavailable ({e})")
+        return
+    if not sample:
+        print("device memory: no allocator stats on this backend")
+        return
+    peaks = sampler.peaks()
+    for dev, row in sorted(sample.items()):
+        parts = []
+        for kind in ("bytes_in_use", "bytes_limit", "live_bytes",
+                     "live_arrays"):
+            if kind in row:
+                v = row[kind]
+                parts.append(f"{kind}={int(v):,}" if kind != "live_arrays"
+                             else f"{kind}={int(v)}")
+        if dev in peaks:
+            parts.append(f"peak={int(peaks[dev]):,}")
+        print(f"device memory [{dev}]: {' '.join(parts) or '(empty)'}")
 
 
 def _print_metrics_snapshot(metrics_url: Optional[str]) -> None:
@@ -623,6 +655,53 @@ def cmd_storageserver(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """On-demand profiler capture (obs.profiler).
+
+    With --url, arms the capture on a RUNNING admin server
+    (POST /admin/profile) and returns immediately — the artifact lands on
+    the server's disk.  Without it, captures THIS process for the window
+    (mostly useful under `pio shell` or to smoke-test the platform)."""
+    duration_ms = args.duration_ms
+    if args.url:
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        url = (args.url.rstrip("/")
+               + f"/admin/profile?duration_ms={duration_ms:g}")
+        if args.out:
+            from urllib.parse import quote
+
+            url += f"&out={quote(args.out)}"
+        try:
+            with urlopen(Request(url, method="POST"), timeout=30) as resp:
+                body = json.loads(resp.read() or b"{}")
+        except HTTPError as e:
+            payload = e.read()
+            try:
+                msg = json.loads(payload).get("message", "")
+            except Exception:
+                msg = payload.decode(errors="replace")[:200]
+            _die(f"profile request failed: HTTP {e.code}: {msg}")
+        except OSError as e:
+            _die(f"cannot reach {args.url}: {e}")
+        print(f"Profiling for {body.get('durationMs', duration_ms):g} ms; "
+              f"artifacts: {body.get('path')}")
+        print("(view in TensorBoard/XProf or chrome://tracing once the "
+              "window closes)")
+        return 0
+    from predictionio_tpu.obs.profiler import ProfilerUnavailable, capture
+
+    try:
+        path = capture(duration_ms, args.out)
+    except ValueError as e:  # bad --duration-ms: same clean error as --url
+        _die(str(e))
+    except ProfilerUnavailable as e:
+        _die(f"this platform cannot capture a profile: {e}")
+    print(f"Profile captured: {path}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import DashboardServer
 
@@ -863,6 +942,19 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
     db.set_defaults(fn=cmd_dashboard)
+
+    pf = sub.add_parser("profile", help="on-demand JAX profiler capture "
+                                        "(local, or a running admin "
+                                        "server via --url)")
+    pf.add_argument("--duration-ms", dest="duration_ms", type=float,
+                    default=2000.0, help="capture window (default 2000)")
+    pf.add_argument("--url", default=None,
+                    help="admin server base URL (e.g. "
+                         "http://127.0.0.1:7071) — capture happens there")
+    pf.add_argument("--out", default=None,
+                    help="artifact directory (default: fresh temp dir; "
+                         "env PIO_PROFILE_OUT)")
+    pf.set_defaults(fn=cmd_profile)
 
     imp = sub.add_parser("import", help="import NDJSON events")
     imp.add_argument("--appid", type=int, required=True)

@@ -37,6 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs.runtime import get_compile_tracker
 from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import AXIS_EXPERT, put_sharded
 
@@ -233,9 +234,15 @@ def _train_step_impl(state_tuple, dense, cat, labels, weights, key: _StepKey):
     return (params, opt_state, step + 1), loss
 
 
+# Compile tracking (obs.runtime): see two_tower — bench.py keeps the raw
+# _train_step_impl for its fused-loop harness.
+_tracked_train_step = get_compile_tracker().wrap(
+    "dlrm.train_step", _train_step_impl)
+
+
 def train_step(state: DLRMState, dense, cat, labels, weights,
                cfg: DLRMConfig, mesh: Optional[Mesh] = None):
-    (p, o, s), loss = _train_step_impl(
+    (p, o, s), loss = _tracked_train_step(
         (state.params, state.opt_state, state.step),
         dense, cat, labels, weights, _StepKey(cfg, mesh))
     return DLRMState(params=p, opt_state=o, step=s), loss

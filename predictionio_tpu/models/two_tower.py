@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs.runtime import get_compile_tracker
 from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, put_sharded
 
@@ -168,11 +169,19 @@ def _train_step_impl(state: Tuple, user_ids, item_ids, weights, cfg) -> Tuple:
     return (params, opt_state, step + 1), loss
 
 
+# Compile tracking (obs.runtime): cache growth across a call = an XLA
+# compilation, exported as pio_xla_compile_total{fn=...} + shape-churn
+# warnings.  bench.py keeps importing the raw _train_step_impl (it nests
+# the step inside its own fused jit, where per-call tracking is noise).
+_tracked_train_step = get_compile_tracker().wrap(
+    "two_tower.train_step", _train_step_impl)
+
+
 # dataclasses aren't pytrees; tuple in/out keeps jit donation simple.
 def train_step(state: TwoTowerState, user_ids, item_ids, weights,
                cfg: TwoTowerConfig) -> Tuple[TwoTowerState, jax.Array]:
     hcfg = _HashableConfig(cfg)
-    (p, o, s), loss = _train_step_impl(
+    (p, o, s), loss = _tracked_train_step(
         (state.params, state.opt_state, state.step),
         user_ids, item_ids, weights, hcfg)
     return TwoTowerState(params=p, opt_state=o, step=s), loss

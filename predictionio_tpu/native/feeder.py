@@ -21,7 +21,7 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from predictionio_tpu.native.build import load_library
-from predictionio_tpu.obs import get_registry
+from predictionio_tpu.obs import get_registry, publish_event
 
 __all__ = ["write_cache", "EventFeeder"]
 
@@ -156,7 +156,11 @@ class EventFeeder:
             raise RuntimeError("feeder error")
         self._m_wait.observe(wait_ms)
         if n == 0:
-            # Epoch boundary: the whole dataset is queued again.
+            # Epoch boundary: the whole dataset is queued again.  The
+            # trace-ring event correlates feeder epoch turnover with
+            # whatever request/run is being explained.
+            publish_event("feeder.epoch", rows=self._epoch_served,
+                          batchSize=self.batch_size)
             self._epoch_served = 0
             self._m_depth.set(len(self))
             return None

@@ -11,6 +11,12 @@ Three parts, one process-wide state:
   logging (``PIO_SLOW_REQUEST_MS``).
 - :mod:`predictionio_tpu.obs.pipeline` — training-loop probe decomposing
   the feeder→device pipeline into host-wait / H2D / device-step.
+- :mod:`predictionio_tpu.obs.runtime` — runtime introspection below the
+  request/training layer: XLA compile tracking, device-memory telemetry,
+  the per-step timeline ring, and trace-ring event publication.
+- :mod:`predictionio_tpu.obs.profiler` — on-demand bounded
+  ``jax.profiler`` capture behind ``POST /admin/profile`` and
+  ``pio profile``.
 
 stdlib-only on import: safe from the CLI, the servers, and the data layer
 without touching jax/numpy.
@@ -25,9 +31,23 @@ from predictionio_tpu.obs.metrics import (
     set_registry,
 )
 from predictionio_tpu.obs.pipeline import PipelineProbe
+from predictionio_tpu.obs.runtime import (
+    CompileTracker,
+    DeviceMemorySampler,
+    StepTimeline,
+    get_compile_tracker,
+    get_memory_sampler,
+    get_timeline,
+    publish_event,
+    reset_runtime,
+    set_timeline,
+    start_runtime_introspection,
+    track_compiles,
+)
 from predictionio_tpu.obs.trace import (
     Span,
     TraceRecorder,
+    current_span,
     current_trace_id,
     get_recorder,
     new_trace_id,
@@ -46,8 +66,19 @@ __all__ = [
     "get_registry",
     "set_registry",
     "PipelineProbe",
+    "CompileTracker",
+    "DeviceMemorySampler",
+    "StepTimeline",
+    "get_compile_tracker",
+    "get_memory_sampler",
+    "get_timeline",
+    "publish_event",
+    "set_timeline",
+    "start_runtime_introspection",
+    "track_compiles",
     "Span",
     "TraceRecorder",
+    "current_span",
     "current_trace_id",
     "get_recorder",
     "new_trace_id",
@@ -83,6 +114,8 @@ def phase(name: str, *, metric: str = "pio_train_phase_ms", **attrs):
 
 
 def reset_observability() -> None:
-    """Fresh registry + empty trace ring (test isolation; see conftest)."""
+    """Fresh registry + empty trace ring + empty timeline/peaks (test
+    isolation; see conftest)."""
     get_registry().reset()
     get_recorder().clear()
+    reset_runtime()

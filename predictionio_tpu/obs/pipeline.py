@@ -33,19 +33,23 @@ from predictionio_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
 )
+from predictionio_tpu.obs.runtime import StepTimeline, get_timeline
 
 __all__ = ["PipelineProbe"]
 
 
 class _Timed:
-    """Context manager recording elapsed ms into a histogram (+gauge)."""
+    """Context manager recording elapsed ms into a histogram (+gauge) and
+    the probe's current-iteration scratch (for the timeline record)."""
 
-    __slots__ = ("_hist", "_gauge", "_labels", "_t0")
+    __slots__ = ("_hist", "_gauge", "_labels", "_t0", "_cur", "_key")
 
-    def __init__(self, hist, gauge, labels):
+    def __init__(self, hist, gauge, labels, cur=None, key=None):
         self._hist = hist
         self._gauge = gauge
         self._labels = labels
+        self._cur = cur
+        self._key = key
         self._t0 = 0.0
 
     def __enter__(self):
@@ -56,6 +60,8 @@ class _Timed:
         ms = (time.perf_counter() - self._t0) * 1e3
         self._hist.observe(ms, **self._labels)
         self._gauge.set(ms, **self._labels)
+        if self._cur is not None:
+            self._cur[self._key] = ms
         return False
 
 
@@ -75,9 +81,11 @@ class PipelineProbe:
     """
 
     def __init__(self, model: str,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 timeline: Optional[StepTimeline] = None):
         reg = registry or get_registry()
         self.model = model
+        self._timeline = timeline if timeline is not None else get_timeline()
         self._labels = {"model": model}
         labelnames = ("model",)
         self._host_wait = reg.histogram(
@@ -114,6 +122,14 @@ class PipelineProbe:
             "Training examples consumed (pre-padding).", labelnames)
         self._pending: Optional[Any] = None
         self._pending_t0 = 0.0
+        # Current-iteration scratch + the dispatched-step snapshot: the
+        # loop overwrites _cur with step N's host_wait/h2d while step N-1
+        # is still in flight, so dispatched() freezes _cur into
+        # _pending_meta and sync() emits the completed step's timeline
+        # record from the frozen copy.
+        self._cur: dict = {}
+        self._pending_meta: Optional[dict] = None
+        self._step_no = 0
 
     # -- host side ---------------------------------------------------------
 
@@ -129,10 +145,12 @@ class PipelineProbe:
             ms = (time.perf_counter() - t0) * 1e3
             self._host_wait.observe(ms, **self._labels)
             self._last["host_wait"].set(ms, **self._labels)
+            self._cur = {"host_wait": ms, "start_s": time.time() - ms / 1e3}
             yield batch
 
     def h2d(self) -> _Timed:
-        return _Timed(self._h2d, self._last["h2d"], self._labels)
+        return _Timed(self._h2d, self._last["h2d"], self._labels,
+                      self._cur, "h2d")
 
     # -- device side (one-step lag) ----------------------------------------
 
@@ -150,7 +168,18 @@ class PipelineProbe:
         self._last["device_wait"].set((t1 - t0) * 1e3, **self._labels)
         self._device_step.observe((t1 - self._pending_t0) * 1e3,
                                   **self._labels)
+        meta = self._pending_meta or {}
+        self._timeline.record(
+            self.model,
+            step=meta.get("step"),
+            start_s=meta.get("start_s"),
+            host_wait_ms=meta.get("host_wait", 0.0),
+            h2d_ms=meta.get("h2d", 0.0),
+            device_wait_ms=(t1 - t0) * 1e3,
+            device_step_ms=(t1 - self._pending_t0) * 1e3,
+            examples=meta.get("examples", 0))
         self._pending = None
+        self._pending_meta = None
 
     def dispatched(self, outputs: Any, examples: int = 0) -> None:
         """Register a freshly dispatched step's outputs for the next sync."""
@@ -159,6 +188,13 @@ class PipelineProbe:
         self._steps.inc(**self._labels)
         if examples:
             self._examples.inc(examples, **self._labels)
+        self._step_no += 1
+        meta = dict(self._cur)
+        meta.setdefault("start_s", time.time())
+        meta["step"] = self._step_no
+        meta["examples"] = examples
+        self._pending_meta = meta
+        self._cur = {}
 
     def finish(self) -> None:
         """Drain the last in-flight step (end of the training loop)."""

@@ -1,0 +1,191 @@
+"""On-demand JAX profiler capture (Dapper-style sample-on-demand).
+
+Continuous xplane capture is too heavy to leave on, so capture is armed
+on demand — ``POST /admin/profile?duration_ms=`` on the admin server or
+the ``pio profile`` CLI verb — runs for a bounded window, and stops
+itself.  One capture at a time per process (the underlying
+``jax.profiler`` session is a process singleton).
+
+The start/stop callables are injectable so tests exercise the whole
+state machine — busy, finished, platform-can't-capture — with fakes and
+no real profiler artifacts; the HTTP layer maps
+:class:`ProfilerUnavailable` to a clear **501** instead of crashing when
+the platform cannot capture (no jax, no profiler plugin, remote-tunnel
+backends).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from predictionio_tpu.obs.runtime import publish_event
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ProfilerUnavailable",
+    "ProfilerBusy",
+    "ProfilerSession",
+    "get_profiler",
+    "set_profiler",
+    "capture",
+]
+
+# Hard ceiling on a requested capture window: an unattended multi-minute
+# xplane capture can fill a disk.
+MAX_CAPTURE_MS = 600_000.0
+
+
+class ProfilerUnavailable(RuntimeError):
+    """This platform/process cannot capture a profile (mapped to 501)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already running (mapped to 409)."""
+
+
+def _default_start(path: str) -> None:
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is present in CI
+        raise ProfilerUnavailable(f"jax unavailable: {e}") from e
+    try:
+        jax.profiler.start_trace(path)
+    except ProfilerUnavailable:
+        raise
+    except Exception as e:
+        raise ProfilerUnavailable(
+            f"profiler capture unsupported here: {e}") from e
+
+
+def _default_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class ProfilerSession:
+    """One-at-a-time timed profiler capture with injectable backend.
+
+    ``start(duration_ms)`` arms the capture and schedules the stop on a
+    timer thread; ``stop()`` is idempotent and safe to call early.  The
+    artifact directory defaults to a fresh ``pio_profile_*`` temp dir
+    (override per call or via ``PIO_PROFILE_OUT``).
+    """
+
+    def __init__(self,
+                 start_fn: Callable[[str], None] = _default_start,
+                 stop_fn: Callable[[], None] = _default_stop,
+                 clock: Callable[[], float] = time.monotonic,
+                 timer_factory: Callable[..., threading.Timer]
+                 = threading.Timer):
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._clock = clock
+        self._timer_factory = timer_factory
+        self._lock = threading.Lock()
+        self._active_path: Optional[str] = None
+        self._started_at: Optional[float] = None
+        self._duration_ms: float = 0.0
+        self._timer: Optional[threading.Timer] = None
+        self._last_path: Optional[str] = None
+
+    def start(self, duration_ms: float,
+              out_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Arm a capture; returns {"path", "durationMs"}.
+
+        Raises :class:`ProfilerBusy` when a capture is running and
+        :class:`ProfilerUnavailable` when the platform cannot capture.
+        """
+        try:
+            duration_ms = float(duration_ms)
+        except (TypeError, ValueError):
+            raise ValueError(f"bad duration_ms: {duration_ms!r}") from None
+        if not duration_ms > 0:
+            raise ValueError("duration_ms must be > 0")
+        duration_ms = min(duration_ms, MAX_CAPTURE_MS)
+        path = (out_dir or os.environ.get("PIO_PROFILE_OUT")
+                or tempfile.mkdtemp(prefix="pio_profile_"))
+        with self._lock:
+            if self._active_path is not None:
+                raise ProfilerBusy(
+                    f"capture already running to {self._active_path}")
+            self._start_fn(path)  # ProfilerUnavailable propagates un-armed
+            self._active_path = path
+            self._started_at = self._clock()
+            self._duration_ms = duration_ms
+            self._timer = self._timer_factory(duration_ms / 1e3, self.stop)
+            self._timer.daemon = True
+            self._timer.start()
+        publish_event("profiler.start", path=path,
+                      durationMs=round(duration_ms, 1))
+        logger.info("profiler capture started: %s (%.0f ms)", path,
+                    duration_ms)
+        return {"path": path, "durationMs": duration_ms}
+
+    def stop(self) -> Optional[str]:
+        """Finish the active capture; returns its path (None if idle)."""
+        with self._lock:
+            path = self._active_path
+            if path is None:
+                return None
+            timer, self._timer = self._timer, None
+            self._active_path = None
+            self._started_at = None
+            self._last_path = path
+            try:
+                self._stop_fn()
+            except Exception:
+                # the capture window still produced whatever landed on
+                # disk before the stop failed — report the path anyway
+                logger.exception("profiler stop failed (artifacts may be "
+                                 "partial): %s", path)
+        if timer is not None:
+            timer.cancel()
+        publish_event("profiler.stop", path=path)
+        logger.info("profiler capture finished: %s", path)
+        return path
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._active_path is None:
+                return {"active": False, "lastPath": self._last_path}
+            elapsed_ms = (self._clock() - (self._started_at or 0.0)) * 1e3
+            return {"active": True, "path": self._active_path,
+                    "durationMs": self._duration_ms,
+                    "remainingMs": max(self._duration_ms - elapsed_ms, 0.0)}
+
+
+_profiler = ProfilerSession()
+_profiler_lock = threading.Lock()
+
+
+def get_profiler() -> ProfilerSession:
+    """THE process profiler session (admin server + CLI)."""
+    return _profiler
+
+
+def set_profiler(session: ProfilerSession) -> ProfilerSession:
+    """Swap the process session (tests); returns the previous one."""
+    global _profiler
+    with _profiler_lock:
+        prev, _profiler = _profiler, session
+    return prev
+
+
+def capture(duration_ms: float, out_dir: Optional[str] = None,
+            sleep: Callable[[float], None] = time.sleep) -> str:
+    """Blocking capture (the local ``pio profile`` path): start, wait the
+    window out, stop, return the artifact path."""
+    session = get_profiler()
+    info = session.start(duration_ms, out_dir)
+    # start() caps the window at MAX_CAPTURE_MS — wait out the CAPPED
+    # duration, not the raw request, or an over-asked CLI blocks long
+    # after the timer already stopped the capture.
+    sleep(info["durationMs"] / 1e3)
+    return session.stop() or info["path"]

@@ -47,6 +47,7 @@ __all__ = [
     "Span",
     "span",
     "trace",
+    "current_span",
     "current_trace_id",
     "new_trace_id",
     "sanitize_trace_id",
@@ -80,6 +81,13 @@ def sanitize_trace_id(raw: Optional[str]) -> Optional[str]:
 
 def current_trace_id() -> Optional[str]:
     return _current_trace_id.get()
+
+
+def current_span() -> Optional[Span]:
+    """The innermost OPEN span of this context (None = tracing inactive).
+    Lets out-of-band instrumentation (obs.runtime.publish_event) attach
+    annotations to the request/run that triggered them."""
+    return _current_span.get()
 
 
 # Map perf_counter readings to wall clock ONCE: spans then pay a single

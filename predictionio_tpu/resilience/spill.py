@@ -46,7 +46,7 @@ import uuid
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
-from predictionio_tpu.obs import get_registry
+from predictionio_tpu.obs import get_registry, publish_event
 from predictionio_tpu.resilience.policy import CircuitOpenError
 
 logger = logging.getLogger(__name__)
@@ -114,6 +114,10 @@ class SpillJournal:
         self._recover()
         self._f = open(self.path, "a", encoding="utf-8")
         self._depth_gauge.set(self._pending_events)
+        # Trace-ring incident record: a journal opening with backlog is
+        # the first sign of a prior outage/crash worth correlating.
+        publish_event("spill.open", dir=str(self.dir),
+                      pendingEvents=self._pending_events)
 
     def _acquire_dir(self, base: Path) -> Path:
         try:
@@ -223,6 +227,11 @@ class SpillJournal:
             self._pending_events += len(record["events"])
             self._depth_gauge.set(self._pending_events)
         self._spilled.inc(len(record["events"]))
+        # Inside the ingest request's trace: THIS request degraded to the
+        # journal — the 202 in the ring explains itself.
+        publish_event("spill.append", token=token,
+                      events=len(record["events"]),
+                      pendingEvents=self.depth())
         return token
 
     def peek(self, n: int) -> List[Dict[str, Any]]:
@@ -274,7 +283,10 @@ class SpillJournal:
             return
         with self._lock:
             self._advance(records)
-        self._replayed.inc(sum(len(r["events"]) for r in records))
+        n = sum(len(r["events"]) for r in records)
+        self._replayed.inc(n)
+        publish_event("spill.replayed", events=n,
+                      pendingEvents=self.depth())
 
     def dead_letter(self, record: Dict[str, Any], reason: str) -> None:
         """Skip a permanently unreplayable record: persist it to the
@@ -288,6 +300,8 @@ class SpillJournal:
                                    separators=(",", ":")) + "\n")
             self._advance([record])
         self._dead.inc(len(record["events"]))
+        publish_event("spill.dead_letter", token=record.get("token"),
+                      events=len(record["events"]), reason=reason)
 
     def close(self) -> None:
         with self._lock:

@@ -25,6 +25,9 @@ Resilience additions (README "Resilience"):
 - Deadline propagation: ``deadline_ms=...`` stamps every request with
   ``X-PIO-Deadline-Ms`` so servers can shed work that cannot finish in
   budget (504) instead of queueing it.
+- Typed ingest result: ``create_event`` returns :class:`EventResult` — a
+  ``str`` (the old return shape) that also says whether the value is a
+  durably-stored event id (201) or a 202 spill token.
 """
 
 from __future__ import annotations
@@ -41,7 +44,42 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence
 from predictionio_tpu.resilience.deadline import DEADLINE_HEADER
 from predictionio_tpu.resilience.policy import RetryPolicy
 
-__all__ = ["PredictionIOError", "EventClient", "EngineClient"]
+__all__ = ["PredictionIOError", "EventResult", "EventClient", "EngineClient"]
+
+
+class EventResult(str):
+    """``create_event``'s typed result (ROADMAP resilience follow-on (e)).
+
+    A ``str`` subclass, so every existing caller that treated the return
+    value as "the id string" keeps working unchanged — but the value a
+    202 carries is a spill TOKEN, not an event id (the real id is
+    assigned at replay and cannot be fetched/deleted by token).  New
+    callers distinguish the two::
+
+        r = client.create_event(...)
+        if r.stored:          # 201: durably stored, r.event_id is real
+            audit(r.event_id)
+        else:                 # 202: journaled server-side, r.token
+            metrics.spilled += 1
+
+    ``status`` carries the HTTP status (201 or 202).
+    """
+
+    __slots__ = ("event_id", "token", "status")
+
+    def __new__(cls, value: str, *, event_id: Optional[str] = None,
+                token: Optional[str] = None, status: Optional[int] = None):
+        self = super().__new__(cls, value)
+        self.event_id = event_id
+        self.token = token
+        self.status = status
+        return self
+
+    @property
+    def stored(self) -> bool:
+        """True when the event is durably stored under ``event_id``;
+        False when it was 202-journaled for replay (``token``)."""
+        return self.event_id is not None
 
 
 class PredictionIOError(RuntimeError):
@@ -77,7 +115,10 @@ def _retry_after_s(headers) -> Optional[float]:
 
 def _request(method: str, url: str, body: Optional[Any] = None,
              timeout: float = 10.0, *, retry: Optional[RetryPolicy] = None,
-             deadline_ms: Optional[float] = None) -> Any:
+             deadline_ms: Optional[float] = None,
+             want_status: bool = False) -> Any:
+    """``want_status=True`` returns ``(http_status, payload)`` — the
+    typed create_event result needs to tell a 201 from a 202."""
     data = json.dumps(body).encode() if body is not None else None
     # One absolute deadline for the WHOLE call, retries included: each
     # attempt sends the REMAINING budget (the header's documented
@@ -102,7 +143,8 @@ def _request(method: str, url: str, body: Optional[Any] = None,
         try:
             with urllib.request.urlopen(req, timeout=attempt_timeout) as resp:
                 payload = resp.read()
-                return json.loads(payload) if payload else None
+                parsed = json.loads(payload) if payload else None
+                return (resp.status, parsed) if want_status else parsed
         except urllib.error.HTTPError as e:
             payload = e.read()
             try:
@@ -156,9 +198,10 @@ class EventClient:
             params.update({k: v for k, v in extra.items() if v is not None})
         return urllib.parse.urlencode(params, doseq=True)
 
-    def _request(self, method: str, url: str, body: Optional[Any] = None) -> Any:
+    def _request(self, method: str, url: str, body: Optional[Any] = None,
+                 **kw) -> Any:
         return _request(method, url, body, self.timeout, retry=self.retry,
-                        deadline_ms=self.deadline_ms)
+                        deadline_ms=self.deadline_ms, **kw)
 
     @staticmethod
     def _iso(t) -> Optional[str]:
@@ -172,7 +215,7 @@ class EventClient:
                      target_entity_type: Optional[str] = None,
                      target_entity_id: Optional[str] = None,
                      properties: Optional[Mapping[str, Any]] = None,
-                     event_time=None) -> str:
+                     event_time=None) -> EventResult:
         body: Dict[str, Any] = {
             "event": event, "entityType": entity_type, "entityId": entity_id}
         if target_entity_type:
@@ -183,16 +226,18 @@ class EventClient:
             body["properties"] = dict(properties)
         if event_time is not None:
             body["eventTime"] = self._iso(event_time)
-        out = self._request("POST", f"{self.base}/events.json?{self._qs()}",
-                            body)
+        status, out = self._request(
+            "POST", f"{self.base}/events.json?{self._qs()}", body,
+            want_status=True)
         # 201 carries eventId; a 202 (storage outage, event journaled
-        # server-side) carries the spill token instead.  A token is NOT
-        # an event id — it cannot be passed to get_event/delete_event
-        # (the event's real id is assigned at replay).  Callers that
-        # need to tell them apart should check ``"eventId" in out`` via
-        # create_events()'s per-item dicts or treat a 202 as fire-and-
-        # forget acceptance.
-        return out.get("eventId") or out.get("token")
+        # server-side) carries the spill token instead.  The returned
+        # EventResult IS the old string (compat) plus .event_id/.token/
+        # .stored so callers can finally tell them apart.
+        out = out or {}
+        event_id = out.get("eventId")
+        token = out.get("token")
+        return EventResult(event_id or token or "", event_id=event_id,
+                           token=token, status=status)
 
     def create_events(self, events: Sequence[Mapping[str, Any]]) -> List[Dict]:
         """Batch ingest (reference: /batch/events.json, ≤50 per call)."""

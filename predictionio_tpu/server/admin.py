@@ -1,4 +1,4 @@
-"""Admin API server — app/key management over REST.
+"""Admin API server — app/key management + runtime ops over REST.
 
 Reference: tools/.../tools/admin/ (SURVEY.md §2.1 Tools/CLI row) — the
 experimental `pio adminserver` (default :7071) exposing the console's app
@@ -9,6 +9,15 @@ commands as JSON endpoints:
 - ``POST /v1/cmd/app``           → create app  ``{"name": ..., "description"?}``
 - ``DELETE /v1/cmd/app/<name>``  → delete app and all its data
 - ``DELETE /v1/cmd/app/<name>/data`` → wipe event data only
+
+Rebuild additions (runtime introspection):
+
+- ``POST /admin/profile?duration_ms=`` → arm a bounded on-demand
+  ``jax.profiler`` capture; answers the artifact path immediately, 409
+  while a capture runs, and a clear **501** when the platform cannot
+  capture (instead of crashing).  ``GET /admin/profile`` → status.
+- ``GET /timeline.json`` → the per-step pipeline timeline ring
+  (``?format=chrome`` for chrome://tracing).
 """
 
 from __future__ import annotations
@@ -16,11 +25,19 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from typing import Optional, Tuple
-from urllib.parse import urlparse
+from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.storage import AccessKey, App, Storage, get_storage
-from predictionio_tpu.server.http import BaseHandler, ThreadingHTTPServer
+from predictionio_tpu.obs.profiler import (
+    ProfilerBusy,
+    ProfilerUnavailable,
+    get_profiler,
+)
+from predictionio_tpu.server.http import (
+    BaseHandler,
+    ThreadingHTTPServer,
+    timeline_payload,
+)
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -41,10 +58,17 @@ class AdminServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    def handle(self, method: str, path: str, body: bytes) -> Tuple[int, dict | list]:
+    def handle(self, method: str, path: str, body: bytes,
+               params: Optional[Dict[str, List[str]]] = None
+               ) -> Tuple[int, dict | list]:
+        params = params or {}
         try:
             if path == "/" and method == "GET":
                 return 200, {"status": "alive", "version": __version__}
+            if path == "/admin/profile":
+                return self._handle_profile(method, params)
+            if path == "/timeline.json" and method == "GET":
+                return 200, timeline_payload(params)
             if path == "/v1/cmd/app" and method == "GET":
                 apps = self.storage.get_apps().get_all()
                 keys = self.storage.get_access_keys()
@@ -93,26 +117,49 @@ class AdminServer:
             logger.exception("admin server error")
             return 500, {"message": "Internal server error."}
 
+    def _handle_profile(self, method: str,
+                        params: Dict[str, List[str]]) -> Tuple[int, dict]:
+        """On-demand profiler capture (ISSUE 3 tentpole part 3)."""
+        profiler = get_profiler()
+        if method == "GET":
+            return 200, profiler.status()
+        if method != "POST":
+            return 404, {"message": "Not Found"}
+        raw = params.get("duration_ms", ["2000"])[0]
+        try:
+            duration_ms = float(raw)
+            if not duration_ms > 0:
+                raise ValueError
+        except ValueError:
+            return 400, {"message": f"bad duration_ms: {raw!r}"}
+        out_dir = params.get("out", [None])[0]
+        try:
+            info = profiler.start(duration_ms, out_dir)
+        except ProfilerBusy as e:
+            return 409, {"message": str(e)}
+        except ProfilerUnavailable as e:
+            # The clear degrade: this platform/process cannot capture
+            # (no jax, no profiler plugin, remote-tunnel backend) — a
+            # 501 the caller can act on, never a crash/500.
+            return 501, {"message": f"profiler capture unavailable: {e}"}
+        return 200, {"status": "profiling", **info}
+
     def _make_handler(server_self):
         class Handler(BaseHandler):
             server_log_name = "admin"
+            trace_server_name = "admin"
 
-            def _dispatch(self, method):
-                parsed = urlparse(self.path)
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                status, payload = server_self.handle(method, parsed.path, body)
-                self.respond(status, json.dumps(payload).encode(),
-                             "application/json; charset=UTF-8")
+            def pio_handle(self, method, path, params, body):
+                return server_self.handle(method, path, body, params)
 
             def do_GET(self):  # noqa: N802
-                self._dispatch("GET")
+                self.dispatch("GET")
 
             def do_POST(self):  # noqa: N802
-                self._dispatch("POST")
+                self.dispatch("POST")
 
             def do_DELETE(self):  # noqa: N802
-                self._dispatch("DELETE")
+                self.dispatch("DELETE")
 
         return Handler
 

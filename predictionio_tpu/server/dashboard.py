@@ -4,7 +4,8 @@ Reference: tools/.../tools/dashboard/Dashboard.scala (SURVEY.md §2.1): an
 HTML listing of engine instances (status, times, params) and completed
 evaluations with their metric scores.  JSON endpoints added for tooling:
 ``GET /engine_instances.json``, ``GET /evaluation_instances.json``, plus
-the shared observability views ``GET /metrics`` / ``GET /traces.json``.
+the shared observability views ``GET /metrics`` / ``GET /traces.json`` /
+``GET /timeline.json``.
 """
 
 from __future__ import annotations
@@ -13,24 +14,15 @@ import html
 import json
 import logging
 import threading
-import time
 from typing import Optional, Tuple
-from urllib.parse import urlparse
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import (
-    current_trace_id,
-    get_recorder,
-    get_registry,
-    slow_request_ms,
-    span,
-    trace,
-)
+from predictionio_tpu.obs import get_recorder, get_registry
 from predictionio_tpu.server.http import (
     BaseHandler,
     PROMETHEUS_CTYPE,
     ThreadingHTTPServer,
-    incoming_request_id,
+    timeline_payload,
 )
 from predictionio_tpu.version import __version__
 
@@ -97,7 +89,8 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
 <table><tr><th>ID</th><th>Evaluation</th><th>Status</th><th>Start</th>
 <th>Results</th></tr>{ev}</table></body></html>"""
 
-    def handle(self, method: str, path: str) -> Tuple[int, str, str]:
+    def handle(self, method: str, path: str,
+               params: Optional[dict] = None) -> Tuple[int, str, str]:
         if method != "GET":
             return 404, "application/json", json.dumps({"message": "Not Found"})
         if path == "/":
@@ -107,6 +100,9 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
         if path == "/traces.json":
             return 200, "application/json", json.dumps(
                 {"traces": get_recorder().recent(50)})
+        if path == "/timeline.json":
+            return 200, "application/json", json.dumps(
+                timeline_payload(params or {}))
         if path == "/engine_instances.json":
             rows = [
                 {"id": r.id, "status": r.status,
@@ -134,25 +130,21 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
     def _make_handler(server_self):
         class Handler(BaseHandler):
             server_log_name = "dashboard"
+            trace_server_name = "dashboard"
+
+            def pio_handle(self, method, path, params, body):
+                status, ctype, payload = server_self.handle(method, path,
+                                                            params)
+                return status, payload, ctype
+
+            def pio_on_complete(self, method, path, status, ms, body,
+                                params):
+                server_self._requests.inc(status=str(status))
+                server_self._latency.observe(ms)
+                return None
 
             def do_GET(self):  # noqa: N802
-                t0 = time.perf_counter()
-                with trace("http.request",
-                           trace_id=incoming_request_id(self.headers),
-                           slow_ms=slow_request_ms(),
-                           server="dashboard", method="GET") as troot:
-                    path = urlparse(self.path).path
-                    troot.set(path=path)
-                    with span("http.handle"):
-                        status, ctype, payload = server_self.handle(
-                            "GET", path)
-                    troot.set(status=status)
-                    server_self._requests.inc(status=str(status))
-                    server_self._latency.observe(
-                        (time.perf_counter() - t0) * 1e3)
-                    with span("http.respond"):
-                        self.respond(status, payload.encode(), ctype,
-                                     request_id=current_trace_id())
+                self.dispatch("GET")
 
         return Handler
 

@@ -30,12 +30,10 @@ from predictionio_tpu.data.storage import (
     get_storage,
 )
 from predictionio_tpu.obs import (
-    current_trace_id,
     get_recorder,
     get_registry,
-    slow_request_ms,
     span,
-    trace,
+    start_runtime_introspection,
 )
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
@@ -43,9 +41,7 @@ from predictionio_tpu.resilience.faults import fault_point
 from predictionio_tpu.server.http import (
     BaseHandler,
     ThreadingHTTPServer,
-    incoming_deadline_ms,
-    incoming_request_id,
-    payload_bytes,
+    timeline_payload,
 )
 from predictionio_tpu.version import __version__
 from predictionio_tpu.workflow.core_workflow import (
@@ -156,6 +152,10 @@ class EngineServer:
         self.engine_version = engine_version
         self.requested_instance_id = instance_id
         self.stats = _QueryMetrics()
+        # Runtime introspection: registers pio_xla_compile_* /
+        # pio_device_mem_* so /metrics exposes them from t=0, and starts
+        # the memory-sampler thread (jax is loaded here — models are).
+        start_runtime_introspection()
         self._swap_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -264,7 +264,10 @@ class EngineServer:
 
     # -- HTTP ---------------------------------------------------------------
 
-    def handle(self, method: str, path: str, body: bytes) -> Tuple[int, Any]:
+    def handle(self, method: str, path: str, body: bytes,
+               params: Optional[Dict[str, List[str]]] = None
+               ) -> Tuple[int, Any]:
+        params = params or {}
         try:
             fault_point("http.engine")
             if path == "/" and method == "GET":
@@ -297,6 +300,10 @@ class EngineServer:
                 return 200, self.stats.snapshot()
             if path == "/traces.json" and method == "GET":
                 return 200, {"traces": get_recorder().recent(50)}
+            if path == "/timeline.json" and method == "GET":
+                # Step-timeline ring: ?model=/?n=/?format=chrome for the
+                # chrome://tracing / Perfetto export.
+                return 200, timeline_payload(params)
             if path == "/reload" and method == "POST":
                 instance_id = self.reload()
                 return 200, {"status": "reloaded",
@@ -342,38 +349,22 @@ class EngineServer:
     def _make_handler(server_self):
         class Handler(BaseHandler):
             server_log_name = "engine-server"
+            trace_server_name = "engine"
 
-            def _dispatch(self, method: str):
-                t0 = time.perf_counter()
-                with trace("http.request",
-                           trace_id=incoming_request_id(self.headers),
-                           slow_ms=slow_request_ms(),
-                           server="engine", method=method) as troot:
-                    parsed = urlparse(self.path)
-                    troot.set(path=parsed.path)
-                    with span("http.read"):
-                        length = int(self.headers.get("Content-Length") or 0)
-                        body = self.rfile.read(length) if length else b""
-                    with _deadline.deadline_scope(
-                            incoming_deadline_ms(self.headers)):
-                        with span("http.handle"):
-                            status, payload = server_self.handle(
-                                method, parsed.path, body)
-                    troot.set(status=status)
-                    extra = server_self.plugins.on_request(
-                        f"{method} {parsed.path}", status,
-                        (time.perf_counter() - t0) * 1e3) \
-                        if server_self.plugins else {}
-                    with span("http.respond"):
-                        data, ctype = payload_bytes(payload)
-                        self.respond(status, data, ctype, extra,
-                                     request_id=current_trace_id())
+            def pio_handle(self, method, path, params, body):
+                return server_self.handle(method, path, body, params)
+
+            def pio_on_complete(self, method, path, status, ms, body,
+                                params):
+                return server_self.plugins.on_request(
+                    f"{method} {path}", status, ms) \
+                    if server_self.plugins else None
 
             def do_GET(self):  # noqa: N802
-                self._dispatch("GET")
+                self.dispatch("GET")
 
             def do_POST(self):  # noqa: N802
-                self._dispatch("POST")
+                self.dispatch("POST")
 
         return Handler
 

@@ -46,15 +46,11 @@ from predictionio_tpu.data.storage import (
     get_storage,
 )
 from predictionio_tpu.obs import (
-    current_trace_id,
     get_recorder,
     get_registry,
-    slow_request_ms,
-    span,
-    trace,
+    start_runtime_introspection,
 )
 from predictionio_tpu.resilience import idempotency_key
-from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.resilience.faults import fault_point
 from predictionio_tpu.resilience.policy import CircuitBreaker, CircuitOpenError
@@ -63,13 +59,7 @@ from predictionio_tpu.resilience.spill import (
     SpillJournal,
     resolve_spill_dir,
 )
-from predictionio_tpu.server.http import (
-    BaseHandler,
-    ThreadingHTTPServer,
-    incoming_deadline_ms,
-    incoming_request_id,
-    payload_bytes,
-)
+from predictionio_tpu.server.http import BaseHandler, ThreadingHTTPServer
 
 logger = logging.getLogger(__name__)
 
@@ -135,6 +125,10 @@ class EventServer:
         self.host = host
         self.port = port
         self.stats = _EventMetrics()
+        # Runtime introspection (compile/device-mem instruments + the
+        # memory-sampler thread); jax-free here — the sampler only polls
+        # once some other code in the process has imported jax.
+        start_runtime_introspection()
         # Positive accessKey cache (5 s TTL): the ingest hot path otherwise
         # pays a metadata SELECT per request.  Key revocation propagates
         # within the TTL; auth FAILURES are never cached.
@@ -436,66 +430,41 @@ class EventServer:
     def _make_handler(server_self):
         class Handler(BaseHandler):
             server_log_name = "event-server"
+            trace_server_name = "event"
+            shed_pre_handle = True  # shed BEFORE auth/storage
 
-            def _dispatch(self, method: str):
-                t0 = time.perf_counter()
-                with trace("http.request",
-                           trace_id=incoming_request_id(self.headers),
-                           slow_ms=slow_request_ms(),
-                           server="event", method=method) as troot:
-                    parsed = urlparse(self.path)
-                    troot.set(path=parsed.path)
-                    params = parse_qs(parsed.query)
-                    with span("http.read"):
-                        length = int(self.headers.get("Content-Length") or 0)
-                        body = self.rfile.read(length) if length else b""
-                    with _deadline.deadline_scope(
-                            incoming_deadline_ms(self.headers)):
-                        if _deadline.exceeded():
-                            # Shed BEFORE auth/storage: a request whose
-                            # budget is already gone must not queue.
-                            server_self._shed.inc(server="event")
-                            status, payload = 504, {
-                                "message": "Deadline exceeded."}
-                        else:
-                            with span("http.handle"):
-                                status, payload = server_self.handle(
-                                    method, parsed.path, params, body,
-                                    self.headers)
-                    troot.set(status=status)
-                    name = None
-                    if method == "POST" and parsed.path == "/events.json" \
-                            and status == 201:
-                        try:
-                            name = json.loads(body).get("event")
-                        except Exception:
-                            name = None
-                    # Record BEFORE replying: a client reading /stats.json
-                    # right after its POST completes must see its own event
-                    # counted.
-                    ms = (time.perf_counter() - t0) * 1e3
-                    server_self.stats.record(status, name, ms)
-                    extra = server_self.plugins.on_request(
-                        f"{method} {parsed.path}", status, ms) \
-                        if server_self.plugins else {}
-                    if status in (202, 503):
-                        # Degraded answers carry the backoff hint.
-                        extra = dict(extra or {})
-                        extra.setdefault(
-                            "Retry-After", str(server_self.retry_after_s))
-                    with span("http.respond"):
-                        data, ctype = payload_bytes(payload)
-                        self.respond(status, data, ctype, extra,
-                                     request_id=current_trace_id())
+            def pio_handle(self, method, path, params, body):
+                return server_self.handle(method, path, params, body,
+                                          self.headers)
+
+            def pio_shed(self):
+                server_self._shed.inc(server="event")
+
+            def pio_retry_after_s(self):
+                return server_self.retry_after_s
+
+            def pio_on_complete(self, method, path, status, ms, body,
+                                params):
+                name = None
+                if method == "POST" and path == "/events.json" \
+                        and status == 201:
+                    try:
+                        name = json.loads(body).get("event")
+                    except Exception:
+                        name = None
+                server_self.stats.record(status, name, ms)
+                return server_self.plugins.on_request(
+                    f"{method} {path}", status, ms) \
+                    if server_self.plugins else None
 
             def do_GET(self):  # noqa: N802
-                self._dispatch("GET")
+                self.dispatch("GET")
 
             def do_POST(self):  # noqa: N802
-                self._dispatch("POST")
+                self.dispatch("POST")
 
             def do_DELETE(self):  # noqa: N802
-                self._dispatch("DELETE")
+                self.dispatch("DELETE")
 
         return Handler
 

@@ -17,19 +17,35 @@ request-id glue every frontend speaks:
   measured: 44 ms/req persistent vs 0.9 ms without), debug-level access
   logs, and a :meth:`BaseHandler.respond` helper that writes a JSON or
   Prometheus-text payload with Content-Length and the request-id header.
+- :meth:`BaseHandler.dispatch` — THE request driver every frontend used
+  to copy-paste (with intentional-but-drifting differences; ROADMAP
+  resilience follow-on (d)): trace root + ``http.read`` /
+  ``http.handle`` / ``http.respond`` spans, deadline scope with optional
+  pre-handle shedding, per-server completion hook (stats + plugins), and
+  the ``Retry-After`` hint on degraded answers.  Subclasses implement
+  :meth:`BaseHandler.pio_handle` and override the small hooks below it.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import time
 from http.server import (
     BaseHTTPRequestHandler,
     ThreadingHTTPServer as _ThreadingHTTPServer,
 )
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
+from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs.trace import sanitize_trace_id
+from predictionio_tpu.obs.trace import (
+    current_trace_id,
+    sanitize_trace_id,
+    slow_request_ms,
+    span,
+    trace,
+)
+from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DEADLINE_HEADER
 
 logger = logging.getLogger(__name__)
@@ -43,6 +59,7 @@ __all__ = [
     "incoming_request_id",
     "incoming_deadline_ms",
     "payload_bytes",
+    "timeline_payload",
 ]
 
 REQUEST_ID_HEADER = "X-Request-ID"
@@ -84,14 +101,125 @@ def payload_bytes(payload: Any) -> Tuple[bytes, str]:
     return json.dumps(payload).encode(), "application/json; charset=UTF-8"
 
 
+def timeline_payload(params: Dict[str, List[str]]) -> Dict[str, Any]:
+    """The shared ``GET /timeline.json`` view over the process step
+    timeline.  ``?model=`` filters, ``?n=`` bounds the record count, and
+    ``?format=chrome`` returns Chrome-trace JSON (chrome://tracing /
+    Perfetto); ``?format=summary`` returns only the per-model phase
+    aggregation that ``tools/attribute_gap.py`` consumes."""
+    from predictionio_tpu.obs.runtime import get_timeline
+
+    tl = get_timeline()
+    model = params.get("model", [None])[0]
+    try:
+        n = int(params.get("n", ["256"])[0])
+    except ValueError:
+        n = 256
+    fmt = params.get("format", ["raw"])[0]
+    if fmt == "chrome":
+        return tl.to_chrome_trace(max(n, 1), model=model)
+    models = tl.models() if model is None else [model]
+    summaries = {m: tl.summary(m) for m in models}
+    if fmt == "summary":
+        return {"models": summaries}
+    return {"steps": tl.recent(n, model=model), "models": summaries}
+
+
+# A handler hook's result: (status, payload) with the content type
+# inferred by payload_bytes, or (status, payload, ctype) when the
+# frontend picks its own (the dashboard's HTML pages).
+HandlerResult = Union[Tuple[int, Any], Tuple[int, Any, str]]
+
+
 class BaseHandler(BaseHTTPRequestHandler):
-    """Shared request-handler skeleton; subclasses implement do_* via
-    their server's dispatch and reply through :meth:`respond`."""
+    """Shared request-handler skeleton; subclasses implement
+    :meth:`pio_handle` and route their do_* methods through
+    :meth:`dispatch` (or keep replying directly through :meth:`respond`).
+    """
 
     protocol_version = "HTTP/1.1"
     # See module docstring: keep-alive + Nagle stalls every request ~40 ms.
     disable_nagle_algorithm = True
     server_log_name = "server"
+    # Short server tag used in traces and the shed counter ("event", ...).
+    trace_server_name = "server"
+    # Shed with 504 BEFORE pio_handle when the deadline is already spent
+    # (the event server's pre-auth shed; the engine server sheds inside
+    # its handler, right before the expensive predict, instead).
+    shed_pre_handle = False
+    # Degraded answers that carry the Retry-After backoff hint.
+    retry_after_statuses = (202, 503)
+
+    # -- per-frontend hooks --------------------------------------------------
+
+    def pio_handle(self, method: str, path: str,
+                   params: Dict[str, List[str]], body: bytes) -> HandlerResult:
+        """Handle one request; runs inside the trace + deadline scope."""
+        raise NotImplementedError
+
+    def pio_on_complete(self, method: str, path: str, status: int,
+                        ms: float, body: bytes,
+                        params: Dict[str, List[str]]
+                        ) -> Optional[Dict[str, str]]:
+        """Post-handle hook (stats recording, plugins); runs BEFORE the
+        response is written — a client reading /stats.json right after
+        its own request completes must see it counted.  May return extra
+        response headers."""
+        return None
+
+    def pio_retry_after_s(self) -> Optional[int]:
+        """Backoff hint attached to ``retry_after_statuses`` answers."""
+        return None
+
+    def pio_shed(self) -> None:
+        """Count a transport-level deadline shed (pre-handle 504)."""
+
+    # -- THE request driver --------------------------------------------------
+
+    def dispatch(self, method: str) -> None:
+        t0 = time.perf_counter()
+        with trace("http.request",
+                   trace_id=incoming_request_id(self.headers),
+                   slow_ms=slow_request_ms(),
+                   server=self.trace_server_name, method=method) as troot:
+            parsed = urlparse(self.path)
+            troot.set(path=parsed.path)
+            params = parse_qs(parsed.query)
+            with span("http.read"):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+            with _deadline.deadline_scope(
+                    incoming_deadline_ms(self.headers)):
+                if self.shed_pre_handle and _deadline.exceeded():
+                    # A request whose budget is already gone must not
+                    # queue behind auth/storage.
+                    self.pio_shed()
+                    out: HandlerResult = (504, {"message":
+                                                "Deadline exceeded."})
+                else:
+                    with span("http.handle"):
+                        out = self.pio_handle(method, parsed.path, params,
+                                              body)
+            if len(out) == 3:
+                status, payload, ctype = out  # type: ignore[misc]
+            else:
+                status, payload = out  # type: ignore[misc]
+                ctype = None
+            troot.set(status=status)
+            ms = (time.perf_counter() - t0) * 1e3
+            extra = dict(self.pio_on_complete(method, parsed.path, status,
+                                              ms, body, params) or {})
+            retry_after = self.pio_retry_after_s()
+            if retry_after is not None and status in self.retry_after_statuses:
+                extra.setdefault("Retry-After", str(retry_after))
+            with span("http.respond"):
+                if ctype is None:
+                    data, ctype = payload_bytes(payload)
+                else:
+                    data = (payload.encode() if isinstance(payload, str)
+                            else payload)
+                self.respond(status, data, ctype, extra,
+                             request_id=current_trace_id())
 
     def respond(self, status: int, data: bytes, ctype: str,
                 extra_headers: Optional[Dict[str, str]] = None,

@@ -41,7 +41,11 @@ from predictionio_tpu.data.storage import (
     Model,
     Storage,
 )
-from predictionio_tpu.obs import phase as obs_phase, trace as obs_trace
+from predictionio_tpu.obs import (
+    get_memory_sampler,
+    phase as obs_phase,
+    trace as obs_trace,
+)
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -100,6 +104,13 @@ def run_train(
     instances = storage.get_engine_instances()
     instance_id = instances.insert(instance)
     logger.info("EngineInstance %s TRAINING (factory=%s)", instance_id, variant.engine_factory)
+    # Per-train-run device-memory peak (obs.runtime): fresh peak window
+    # at run start, the poll thread tracks the high-water mark, and the
+    # final sample under the trace pins pio_device_mem_peak_bytes to THIS
+    # run — surfaced by `pio status --metrics-url`.
+    sampler = get_memory_sampler()
+    sampler.reset_peak()
+    sampler.start()
     try:
         # One trace per training run: the DASE phases inside Engine.train
         # (datasource/prepare/algorithm) plus the persist phase below hang
@@ -111,6 +122,7 @@ def run_train(
                 ctx, lambda: engine.train(ctx, engine_params))
             with obs_phase("train.persist"):
                 _persist_models(models, instance_id, ctx)
+            sampler.sample_once()
         instance.status = "COMPLETED"
         instance.end_time = _now()
         instances.update(instance)

@@ -46,3 +46,105 @@ def test_app_crud(admin):
     assert apps == []
     s, _ = _req("DELETE", f"{base}/v1/cmd/app/ghost")
     assert s == 404
+
+
+# -- on-demand profiler capture (obs.profiler) ------------------------------
+
+@pytest.fixture()
+def fake_profiler():
+    """Swap in an injectable ProfilerSession; yields a mutable backend
+    spec the test can point at success/failure behaviors."""
+    from predictionio_tpu.obs import profiler as profiler_mod
+
+    calls = {"started": [], "stopped": 0, "fail": None}
+
+    def start_fn(path):
+        if calls["fail"] is not None:
+            raise calls["fail"]
+        calls["started"].append(path)
+
+    def stop_fn():
+        calls["stopped"] += 1
+
+    class _NoopTimer:
+        def __init__(self, *a, **k):
+            self.daemon = True
+
+        def start(self):
+            pass
+
+        def cancel(self):
+            pass
+
+    session = profiler_mod.ProfilerSession(
+        start_fn=start_fn, stop_fn=stop_fn,
+        timer_factory=lambda *a, **k: _NoopTimer())
+    prev = profiler_mod.set_profiler(session)
+    yield calls
+    profiler_mod.set_profiler(prev)
+
+
+@pytest.mark.profiling
+def test_profile_degrades_to_501_when_platform_cannot_capture(
+        admin, fake_profiler):
+    """The tier-1-safe smoke: an uncapturable platform answers a clear
+    501, never a crash/500 — and arms nothing."""
+    from predictionio_tpu.obs.profiler import ProfilerUnavailable
+
+    fake_profiler["fail"] = ProfilerUnavailable("no profiler plugin here")
+    base = f"http://127.0.0.1:{admin.port}"
+    s, body = _req("POST", f"{base}/admin/profile?duration_ms=50")
+    assert s == 501
+    assert "profiler capture unavailable" in body["message"]
+    assert fake_profiler["started"] == []
+    # and the session is NOT stuck busy after the failure
+    s, body = _req("GET", f"{base}/admin/profile")
+    assert s == 200 and body["active"] is False
+
+
+@pytest.mark.profiling
+def test_profile_capture_roundtrip_and_busy(admin, fake_profiler,
+                                            tmp_path):
+    from predictionio_tpu.obs.profiler import get_profiler
+
+    base = f"http://127.0.0.1:{admin.port}"
+    out = str(tmp_path / "prof")
+    s, body = _req("POST",
+                   f"{base}/admin/profile?duration_ms=1000&out={out}")
+    assert s == 200 and body["status"] == "profiling"
+    assert body["path"] == out
+    assert fake_profiler["started"] == [out]
+    s, body = _req("GET", f"{base}/admin/profile")
+    assert s == 200 and body["active"] is True
+    # second capture while armed: 409, not a second start
+    s, body = _req("POST", f"{base}/admin/profile?duration_ms=1000")
+    assert s == 409
+    assert len(fake_profiler["started"]) == 1
+    # manual stop (the timer is a no-op fake) finishes the session
+    assert get_profiler().stop() == out
+    assert fake_profiler["stopped"] == 1
+    s, body = _req("GET", f"{base}/admin/profile")
+    assert s == 200 and body["active"] is False and body["lastPath"] == out
+
+
+@pytest.mark.profiling
+def test_profile_rejects_bad_duration(admin, fake_profiler):
+    base = f"http://127.0.0.1:{admin.port}"
+    for bad in ("abc", "-5", "0"):
+        s, body = _req("POST", f"{base}/admin/profile?duration_ms={bad}")
+        assert s == 400, bad
+    assert fake_profiler["started"] == []
+
+
+def test_timeline_endpoint_on_admin(admin):
+    from predictionio_tpu.obs import get_timeline
+
+    get_timeline().record("toy", host_wait_ms=1.0, h2d_ms=2.0,
+                          device_wait_ms=3.0, device_step_ms=4.0)
+    base = f"http://127.0.0.1:{admin.port}"
+    s, body = _req("GET", f"{base}/timeline.json")
+    assert s == 200
+    assert body["steps"][0]["model"] == "toy"
+    assert body["models"]["toy"]["steps"] == 1
+    s, chrome = _req("GET", f"{base}/timeline.json?format=chrome")
+    assert s == 200 and any(e["ph"] == "X" for e in chrome["traceEvents"])

@@ -7,22 +7,30 @@ TYPE-before-samples, cumulative ``le`` buckets, ``+Inf`` == ``_count``.
 test_servers.py imports it to validate live ``/metrics`` output.
 """
 
+import importlib.util
 import json
 import math
+import pathlib
 import re
 import threading
 
 import pytest
 
 from predictionio_tpu.obs import (
+    CompileTracker,
+    DeviceMemorySampler,
     MetricsRegistry,
     PipelineProbe,
+    StepTimeline,
     TraceRecorder,
     get_recorder,
     get_registry,
+    get_timeline,
     phase,
+    publish_event,
     reset_observability,
     sanitize_trace_id,
+    set_timeline,
     span,
     trace,
 )
@@ -308,7 +316,8 @@ class TestTracing:
 class TestPipelineProbe:
     def test_decomposition_counts(self):
         reg = MetricsRegistry()
-        probe = PipelineProbe("toy", registry=reg)
+        probe = PipelineProbe("toy", registry=reg,
+                              timeline=StepTimeline(capacity=16))
         batches = [([1, 2], [3, 4]), ([5], [6])]
         seen = []
         for b in probe.iter_host(iter(batches)):
@@ -326,3 +335,324 @@ class TestPipelineProbe:
         # one-step lag: first sync is a no-op, finish drains the last
         assert reg.get("pio_train_device_wait_ms").count(model="toy") == 2
         parse_prometheus(reg.render())
+
+    def test_probe_feeds_timeline_per_step(self):
+        reg = MetricsRegistry()
+        tl = StepTimeline(capacity=16)
+        probe = PipelineProbe("toy", registry=reg, timeline=tl)
+        for b in probe.iter_host(iter([([1, 2],), ([3],)])):
+            with probe.h2d():
+                pass
+            probe.sync()
+            probe.dispatched({"x": 1}, examples=len(b[0]))
+        probe.finish()
+        steps = tl.recent(10, model="toy")
+        assert len(steps) == 2
+        # most recent first; step ids increase; every phase recorded
+        assert [r["step"] for r in steps] == [2, 1]
+        assert steps[0]["examples"] == 1 and steps[1]["examples"] == 2
+        for r in steps:
+            for k in ("hostWaitMs", "h2dMs", "deviceWaitMs",
+                      "deviceStepMs", "startS"):
+                assert r[k] >= 0
+
+
+# -- runtime introspection ---------------------------------------------------
+
+class _FakeJit:
+    """Stands in for a jax.jit wrapper: compiles (cache grows) whenever
+    called with an unseen arg 'shape'."""
+
+    def __init__(self):
+        self.cache = set()
+        self.calls = 0
+
+    def _cache_size(self):
+        return len(self.cache)
+
+    def __call__(self, x):
+        self.calls += 1
+        self.cache.add(x)
+        return x * 2
+
+
+class TestCompileTracker:
+    def setup_method(self):
+        reset_observability()
+
+    def test_counts_only_compiling_calls(self):
+        reg = get_registry()
+        tracker = CompileTracker(warn_threshold=99)
+        fn = tracker.wrap("toy.step", _FakeJit())
+        assert fn(1) == 2
+        assert fn(1) == 2    # cache hit: no compile
+        assert fn(2) == 4    # new "shape": compile
+        c = reg.get("pio_xla_compile_total")
+        assert c.value(fn="toy.step") == 2
+        assert reg.get("pio_xla_compile_ms").count(fn="toy.step") == 2
+        parse_prometheus(reg.render())
+
+    def test_compile_event_lands_in_trace_ring(self):
+        tracker = CompileTracker(warn_threshold=99)
+        fn = tracker.wrap("toy.step", _FakeJit())
+        fn(1)
+        docs = get_recorder().recent(5)
+        assert docs and docs[0]["name"] == "xla.compile"
+        assert docs[0]["attrs"]["fn"] == "toy.step"
+
+    def test_compile_inside_open_trace_attaches_to_request(self):
+        tracker = CompileTracker(warn_threshold=99)
+        fn = tracker.wrap("toy.step", _FakeJit())
+        with trace("http.request", trace_id="req-9"):
+            fn(1)
+        doc, = get_recorder().recent(5)
+        assert doc["traceId"] == "req-9"
+        names = [s["name"] for s in doc.get("spans", [])]
+        assert "xla.compile" in names  # "recompiled here"
+
+    def test_shape_churn_warning_past_threshold(self, caplog):
+        import logging
+
+        tracker = CompileTracker(warn_threshold=2)
+        fn = tracker.wrap("churny.step", _FakeJit())
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.obs.runtime"):
+            fn(1)
+            fn(2)
+            assert not caplog.records  # at threshold: still quiet
+            fn(3)
+        assert any("shape churn" in r.message and "churny.step" in r.message
+                   for r in caplog.records)
+
+    def test_unwrappable_fn_passes_through(self):
+        tracker = CompileTracker(warn_threshold=99)
+        fn = tracker.wrap("plain", lambda x: x + 1)  # no _cache_size
+        assert fn(1) == 2
+        c = get_registry().get("pio_xla_compile_total")
+        assert c is None or c.value(fn="plain") == 0
+
+
+class _FakeDevice:
+    def __init__(self, platform, id, stats):
+        self.platform = platform
+        self.id = id
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+class _FakeArray:
+    def __init__(self, nbytes, device):
+        self.nbytes = nbytes
+        self._device = device
+
+    def devices(self):
+        return {self._device}
+
+
+class TestDeviceMemorySampler:
+    def setup_method(self):
+        reset_observability()
+
+    def test_sample_exports_gauges_and_tracks_peak(self):
+        t = [100.0]
+        stats = {"bytes_in_use": 1000, "peak_bytes_in_use": 1500,
+                 "bytes_limit": 4000}
+        dev = _FakeDevice("tpu", 0, stats)
+        sampler = DeviceMemorySampler(
+            interval_s=0, devices_fn=lambda: [dev],
+            live_arrays_fn=lambda: [], clock=lambda: t[0])
+        out = sampler.sample_once()
+        assert out["tpu:0"]["bytes_in_use"] == 1000
+        g = get_registry().get("pio_device_mem_bytes")
+        assert g.value(device="tpu:0", kind="bytes_in_use") == 1000
+        assert g.value(device="tpu:0", kind="bytes_limit") == 4000
+        peak = get_registry().get("pio_device_mem_peak_bytes")
+        # the window peaks over OUR bytes_in_use samples; the allocator's
+        # monotone peak_bytes_in_use must NOT leak in (it would defeat
+        # reset_peak) — it stays visible as its own kind gauge
+        assert peak.value(device="tpu:0") == 1000
+        assert g.value(device="tpu:0", kind="peak_bytes_in_use") == 1500
+        # memory falls; the peak gauge must NOT fall with it
+        stats["bytes_in_use"] = 200
+        stats["peak_bytes_in_use"] = 0
+        sampler.sample_once()
+        assert peak.value(device="tpu:0") == 1000
+        # fresh train run: window resets, next sample re-establishes
+        sampler.reset_peak()
+        sampler.sample_once()
+        assert peak.value(device="tpu:0") == 200
+        parse_prometheus(get_registry().render())
+
+    def test_live_array_fallback_for_statless_backends(self):
+        dev = _FakeDevice("cpu", 0, None)
+        arrays = [_FakeArray(64, dev), _FakeArray(36, dev)]
+        sampler = DeviceMemorySampler(
+            interval_s=0, devices_fn=lambda: [dev],
+            live_arrays_fn=lambda: arrays)
+        out = sampler.sample_once()
+        assert out["cpu:0"]["live_bytes"] == 100
+        g = get_registry().get("pio_device_mem_bytes")
+        assert g.value(device="cpu:0", kind="live_bytes") == 100
+        assert g.value(device="cpu:0", kind="live_arrays") == 2
+        # live_bytes stands in for bytes_in_use in the peak window
+        assert get_registry().get(
+            "pio_device_mem_peak_bytes").value(device="cpu:0") == 100
+
+    def test_interval_zero_disables_thread(self):
+        sampler = DeviceMemorySampler(interval_s=0,
+                                      devices_fn=lambda: [])
+        assert sampler.start() is False
+
+    def test_device_enumeration_failure_is_quiet(self):
+        def boom():
+            raise RuntimeError("tunnel down")
+
+        sampler = DeviceMemorySampler(interval_s=0, devices_fn=boom,
+                                      live_arrays_fn=lambda: [])
+        assert sampler.sample_once() == {}
+
+
+class TestStepTimeline:
+    def test_ring_bounds_and_summary_shares(self):
+        tl = StepTimeline(capacity=3)
+        for i in range(5):
+            tl.record("m", host_wait_ms=10, h2d_ms=30, device_wait_ms=60,
+                      device_step_ms=70, examples=8, start_s=1000.0 + i)
+        assert len(tl.recent(10)) == 3  # bounded
+        s = tl.summary("m")
+        assert s["steps"] == 3 and s["examples"] == 24
+        assert s["phase_ms"]["h2d"] == 90
+        assert abs(s["phase_share"]["host_wait"] - 0.1) < 1e-6
+        assert abs(s["phase_share"]["device_wait"] - 0.6) < 1e-6
+        # device_step is overlapped: tracked in phase_ms, not in shares
+        assert "device_step" not in s["phase_share"]
+
+    def test_models_filter(self):
+        tl = StepTimeline(capacity=8)
+        tl.record("a", host_wait_ms=1)
+        tl.record("b", h2d_ms=2)
+        assert tl.models() == ["a", "b"]
+        assert [r["model"] for r in tl.recent(10, model="a")] == ["a"]
+
+    def test_chrome_trace_export(self):
+        tl = StepTimeline(capacity=8)
+        tl.record("m", host_wait_ms=1.0, h2d_ms=2.0, device_wait_ms=3.0,
+                  device_step_ms=4.0, start_s=123.0, examples=8)
+        doc = tl.to_chrome_trace()
+        events = doc["traceEvents"]
+        assert {e["ph"] for e in events} == {"M", "X"}
+        xs = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"host_wait", "h2d",
+                                           "device_wait", "device_step"}
+        # host-lane phases tile sequentially from the step start
+        by_name = {e["name"]: e for e in xs}
+        assert by_name["h2d"]["ts"] == pytest.approx(
+            by_name["host_wait"]["ts"] + by_name["host_wait"]["dur"])
+        assert by_name["device_step"]["tid"] != by_name["host_wait"]["tid"]
+        json.dumps(doc)  # must be directly serializable
+
+    def test_process_timeline_swap(self):
+        prev = set_timeline(StepTimeline(capacity=4))
+        try:
+            get_timeline().record("x", host_wait_ms=1)
+            assert get_timeline().models() == ["x"]
+        finally:
+            set_timeline(prev)
+
+
+class TestPublishEvent:
+    def setup_method(self):
+        reset_observability()
+
+    def test_standalone_event_records_trace(self):
+        publish_event("breaker.transition", breaker="b", to="open")
+        doc, = get_recorder().recent(5)
+        assert doc["name"] == "breaker.transition"
+        assert doc["attrs"]["to"] == "open"
+
+    def test_event_inside_trace_attaches_as_child(self):
+        with trace("http.request", trace_id="t1"):
+            publish_event("spill.append", token="tok", events=3)
+        doc, = get_recorder().recent(5)
+        assert doc["traceId"] == "t1"
+        assert [s["name"] for s in doc["spans"]] == ["spill.append"]
+
+
+# -- attribute_gap tool ------------------------------------------------------
+
+def _load_attribute_gap():
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "tools" / "attribute_gap.py")
+    spec = importlib.util.spec_from_file_location("attribute_gap", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestAttributeGap:
+    BENCH = {
+        "tpu_era": {
+            "two_tower_examples_per_sec_per_chip": 1_000_000.0,
+            "two_tower_feeder_examples_per_sec": 800_000.0,
+            "two_tower_pipeline_examples_per_sec": 540_000.0,
+            "two_tower_pipeline_gap_pct": 46.0,
+            "dlrm_examples_per_sec_per_chip": 2_000_000.0,
+            "dlrm_feeder_examples_per_sec": 900_000.0,
+            "dlrm_pipeline_examples_per_sec": 260_000.0,
+            "dlrm_pipeline_gap_pct": 87.0,
+        },
+        "timeline": {
+            "two_tower": {"steps": 6, "examples": 100,
+                          "phase_ms": {"host_wait": 10, "h2d": 70,
+                                       "device_wait": 20,
+                                       "device_step": 25},
+                          "phase_share": {"host_wait": 0.1, "h2d": 0.7,
+                                          "device_wait": 0.2}},
+            "dlrm": {"steps": 6, "examples": 100,
+                     "phase_ms": {"host_wait": 65, "h2d": 20,
+                                  "device_wait": 15, "device_step": 10},
+                     "phase_share": {"host_wait": 0.65, "h2d": 0.2,
+                                     "device_wait": 0.15}},
+        },
+    }
+
+    def test_dominant_component_and_attack(self):
+        mod = _load_attribute_gap()
+        res = mod.attribute(self.BENCH)
+        assert res["two_tower"]["dominant"] == "h2d"
+        assert "buffer" in res["two_tower"]["attack"]
+        assert res["dlrm"]["dominant"] == "host_wait"
+        assert "feeder" in res["dlrm"]["attack"]
+
+    def test_render_prints_both_models_with_shares(self, capsys, tmp_path):
+        mod = _load_attribute_gap()
+        f = tmp_path / "round.json"
+        f.write_text(json.dumps(self.BENCH))
+        assert mod.main([str(f)]) == 0
+        out = capsys.readouterr().out
+        assert "two_tower" in out and "dlrm" in out
+        assert "dominant: h2d" in out and "dominant: host_wait" in out
+        assert "70.0%" in out  # the share of step time is printed
+
+    def test_external_timeline_overrides_and_server_shape(self, tmp_path):
+        mod = _load_attribute_gap()
+        # /timeline.json server shape: summaries under "models"
+        timeline = {"models": {
+            "two_tower": {"steps": 2,
+                          "phase_ms": {"host_wait": 5, "h2d": 1,
+                                       "device_wait": 94},
+                          "phase_share": {"host_wait": 0.05, "h2d": 0.01,
+                                          "device_wait": 0.94}}}}
+        res = mod.attribute(self.BENCH, timeline)
+        assert res["two_tower"]["dominant"] == "device_wait"
+        assert "fusion" in res["two_tower"]["attack"]
+        assert res["dlrm"] is None  # absent from the override
+
+    def test_no_data_exits_nonzero(self, capsys, tmp_path):
+        mod = _load_attribute_gap()
+        f = tmp_path / "round.json"
+        f.write_text(json.dumps({"tpu_era": {}}))
+        assert mod.main([str(f)]) == 1
+        assert "no timeline data" in capsys.readouterr().out

@@ -59,6 +59,38 @@ class TestEventClient:
             c.set_user("u")
         assert ei.value.status == 401
 
+    def test_create_event_typed_result(self, event_stack):
+        """ROADMAP follow-on (e): the result says durably-stored vs
+        journaled, while staying the old plain-string shape."""
+        from predictionio_tpu.sdk import EventResult
+
+        srv, key, *_ = event_stack
+        c = EventClient(key, f"http://127.0.0.1:{srv.port}")
+        r = c.create_event("rate", "user", "u9", "item", "i9",
+                           {"rating": 3.0})
+        assert isinstance(r, EventResult) and isinstance(r, str)
+        assert r.stored and r.status == 201
+        assert r.event_id == str(r) and r.token is None
+        assert c.get_event(r)["event"] == "rate"  # str compat: r IS the id
+
+    def test_create_event_spill_result(self, event_stack, monkeypatch):
+        """A storage outage degrades to 202 + token: .stored is False and
+        the token is NOT presented as an event id."""
+        from predictionio_tpu.data.storage import StorageUnavailable
+
+        srv, key, *_ = event_stack
+        c = EventClient(key, f"http://127.0.0.1:{srv.port}")
+        c.set_user("warm")  # prime the auth cache before the outage
+        events = srv.storage.get_events()
+
+        def down(*a, **k):
+            raise StorageUnavailable("event store down")
+
+        monkeypatch.setattr(type(events), "insert", down)
+        r = c.create_event("rate", "user", "u1", "item", "i1")
+        assert not r.stored and r.status == 202
+        assert r.token == str(r) and r.event_id is None
+
 
 def _train_reco(ctx):
     storage = ctx.storage

@@ -266,6 +266,41 @@ class TestEngineServer:
         assert any(lb.get("phase") == "train.algorithm"
                    for lb, _ in samples.get("pio_train_phase_ms_count", []))
 
+    def test_metrics_expose_runtime_introspection(self, deployed):
+        """ISSUE 3 acceptance: a live engine server's /metrics carries
+        the compile-tracking and device-memory instrument families."""
+        srv, *_ = deployed
+        req = urllib.request.Request(f"http://127.0.0.1:{srv.port}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        assert "pio_xla_compile_total" in text
+        assert "pio_device_mem_bytes" in text
+        from tests.test_obs import parse_prometheus
+
+        samples = parse_prometheus(text)
+        # CPU backend has no allocator stats, but the live-array
+        # fallback gives real series (the loaded model's arrays).
+        assert any(lb.get("kind") == "live_bytes" and v > 0
+                   for lb, v in samples.get("pio_device_mem_bytes", []))
+
+    def test_timeline_endpoint(self, deployed):
+        from predictionio_tpu.obs import get_timeline
+
+        srv, *_ = deployed
+        get_timeline().record("toy", host_wait_ms=1, h2d_ms=2,
+                              device_wait_ms=3, device_step_ms=4,
+                              examples=8)
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _req("GET", f"{base}/timeline.json")
+        assert status == 200 and body["steps"][0]["model"] == "toy"
+        status, body = _req("GET",
+                            f"{base}/timeline.json?format=summary&model=toy")
+        assert status == 200
+        assert body["models"]["toy"]["phase_ms"]["h2d"] == 2
+        status, chrome = _req("GET", f"{base}/timeline.json?format=chrome")
+        assert status == 200
+        assert any(e["ph"] == "X" for e in chrome["traceEvents"])
+
     def test_stats_json_view(self, deployed):
         srv, *_ = deployed
         _req("POST", f"http://127.0.0.1:{srv.port}/queries.json",

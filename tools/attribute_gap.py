@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Attribute the feeder-vs-realized pipeline gap to its dominant component.
+
+BENCH_r05 measured feeder-vs-realized training-throughput gaps of 45.9%
+(two-tower) and 87.0% (DLRM) with no way to say which side of the
+pipeline stalls.  The training loops now decompose every iteration into
+host_wait / h2d / device_wait / device_step (obs.pipeline →
+obs.runtime.StepTimeline), and bench.py embeds the per-model timeline
+summary in its round artifact.  This tool reads a bench round plus that
+timeline and prints, per model, the dominant gap component with its
+share of step time and the recommended attack — the actionable half of
+ROADMAP's "read which component dominates each gap, and attack THAT".
+
+Usage::
+
+    python bench.py > round.json
+    python tools/attribute_gap.py round.json
+    # or against a live server's ring:
+    python tools/attribute_gap.py round.json \\
+        --timeline http://127.0.0.1:8000/timeline.json
+
+The bench artifact may be the raw one-line JSON bench.py prints or any
+JSON object containing its ``tpu_era`` block; ``--timeline`` overrides
+the embedded ``timeline`` block with a file or a ``/timeline.json`` URL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional
+
+MODELS = ("two_tower", "dlrm")
+
+# Component → the attack the next perf PR should mount (ROADMAP wording).
+ATTACKS = {
+    "host_wait": "feeder threads / parallel batch assembly "
+                 "(the host cannot produce batches fast enough)",
+    "h2d": "pinned buffers / double buffering "
+           "(stage batch N+1 while step N runs)",
+    "device_wait": "step fusion or a larger batch size "
+                   "(the device step itself is the bottleneck)",
+}
+
+WALL_PHASES = ("host_wait", "h2d", "device_wait")
+
+
+def load_json(path: str) -> Dict[str, Any]:
+    if path == "-":
+        return json.load(sys.stdin)
+    if path.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(path, timeout=10) as resp:
+            return json.load(resp)
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        # bench logs sometimes carry stray lines around the JSON object;
+        # take the last parseable line (bench.py prints exactly one)
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        raise
+
+
+def _timeline_summaries(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Normalize either bench.py's embedded ``timeline`` block or a
+    server ``/timeline.json`` payload to {model: summary}."""
+    if "models" in doc and isinstance(doc["models"], dict):
+        return doc["models"]  # /timeline.json shape
+    return {k: v for k, v in doc.items() if isinstance(v, dict)}
+
+
+def attribute(bench: Dict[str, Any],
+              timeline: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Compute the attribution; returns {model: {...}} (None entries for
+    models with no usable data)."""
+    tpu_era = bench.get("tpu_era", bench)
+    summaries = _timeline_summaries(
+        timeline if timeline is not None else bench.get("timeline", {}))
+    out: Dict[str, Any] = {}
+    for model in MODELS:
+        gap = tpu_era.get(f"{model}_pipeline_gap_pct")
+        feeder = tpu_era.get(f"{model}_feeder_examples_per_sec")
+        pipe = tpu_era.get(f"{model}_pipeline_examples_per_sec")
+        dev = tpu_era.get(f"{model}_examples_per_sec_per_chip")
+        summary = summaries.get(model) or {}
+        shares = {p: float(summary.get("phase_share", {}).get(p, 0.0))
+                  for p in WALL_PHASES}
+        if not any(shares.values()):
+            out[model] = None
+            continue
+        dominant = max(shares, key=lambda p: shares[p])
+        out[model] = {
+            "gap_pct": gap,
+            "feeder_examples_per_sec": feeder,
+            "pipeline_examples_per_sec": pipe,
+            "device_examples_per_sec": dev,
+            "steps": summary.get("steps"),
+            "phase_share": shares,
+            "phase_ms": summary.get("phase_ms", {}),
+            "dominant": dominant,
+            "dominant_share": shares[dominant],
+            "attack": ATTACKS[dominant],
+        }
+    return out
+
+
+def _fmt_rate(v: Any) -> str:
+    return f"{v:,.0f} ex/s" if isinstance(v, (int, float)) else "?"
+
+
+def render(result: Dict[str, Any]) -> str:
+    lines = []
+    for model in MODELS:
+        r = result.get(model)
+        if r is None:
+            lines.append(f"{model}: no timeline data (run bench.py, or "
+                         "point --timeline at a training process's "
+                         "/timeline.json)")
+            continue
+        gap = r["gap_pct"]
+        head = f"{model}: pipeline gap " + (
+            f"{gap:.1f}%" if isinstance(gap, (int, float)) else "?")
+        if r["feeder_examples_per_sec"] or r["pipeline_examples_per_sec"]:
+            head += (f" (feeder {_fmt_rate(r['feeder_examples_per_sec'])}"
+                     f" -> realized "
+                     f"{_fmt_rate(r['pipeline_examples_per_sec'])}"
+                     f", device ceiling "
+                     f"{_fmt_rate(r['device_examples_per_sec'])})")
+        lines.append(head)
+        shares = r["phase_share"]
+        lines.append("  step-time decomposition: " + " | ".join(
+            f"{p} {shares[p] * 100:.1f}%" for p in WALL_PHASES))
+        lines.append(f"  dominant: {r['dominant']} "
+                     f"({r['dominant_share'] * 100:.1f}% of step wall, "
+                     f"over {r['steps']} steps)")
+        lines.append(f"  attack: {r['attack']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="attribute the feeder-vs-realized pipeline gap")
+    ap.add_argument("bench", nargs="?", default="-",
+                    help="bench.py round artifact (JSON file, '-' stdin)")
+    ap.add_argument("--timeline", default=None, metavar="FILE|URL",
+                    help="step-timeline source overriding the bench "
+                         "artifact's embedded block (a /timeline.json "
+                         "URL or a saved payload)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the attribution as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    bench = load_json(args.bench)
+    timeline = load_json(args.timeline) if args.timeline else None
+    result = attribute(bench, timeline)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(render(result))
+    # Non-zero when NOTHING could be attributed: a wired-up bench must
+    # never silently print two "no data" stanzas and exit 0.
+    return 0 if any(result.get(m) for m in MODELS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

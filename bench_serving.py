@@ -16,7 +16,12 @@ With ``--faults SPEC`` (PIO_FAULTS grammar, e.g.
 the same server — clean, then with the fault plan installed — and the
 line carries ``clean`` / ``faulted`` blocks plus the p99 delta, so a
 round artifact finally records tail latency under injected partial
-failure (ROADMAP resilience follow-on (c)).
+failure (ROADMAP resilience follow-on (c)).  The faulted phase also
+attempts ``POST /reload`` before and during the drive and counts
+predict non-2xx responses: with the store 100% dead
+(``storage.find:error:1.0``) the reload must fail closed while serving
+continues from the last-good model with zero non-2xx
+(BENCH_FAULTS_r02, ISSUE 4).
 """
 
 import argparse
@@ -25,7 +30,9 @@ import json
 import os
 import re
 import tempfile
+import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -66,7 +73,8 @@ def _setup():
     return eng, variant, storage, n_users
 
 
-def _drive(port: int, n_users: int, clients: int, requests: int):
+def _drive(port: int, n_users: int, clients: int, requests: int,
+           count_non_2xx: bool = False):
     """Closed-loop saturation throughput PLUS unloaded latency.
 
     Workers keep persistent connections (an SDK-shaped client) and speak
@@ -90,6 +98,10 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
              + str(len(p)).encode() + b"\r\n\r\n" + p) for p in payloads]
     local = threading.local()
     _CL = b"content-length:"
+    # Faulted mode (ISSUE 4 / BENCH_FAULTS_r02): non-2xx predicts are
+    # COUNTED, not retried — the artifact's claim is "zero non-2xx while
+    # storage is 100% dead", so the client must see every failure.
+    non_2xx = []
 
     def one(raw):
         t0 = time.perf_counter()
@@ -111,8 +123,11 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
                     end = buf.find(b"\r\n\r\n")
                     if end >= 0:
                         break
-                if not buf.startswith(b"HTTP/1.1 200"):
-                    raise RuntimeError(f"serving returned {buf[:30]!r}")
+                if not buf.startswith(b"HTTP/1.1 2"):
+                    if count_non_2xx:
+                        non_2xx.append(buf[:12])
+                    else:
+                        raise RuntimeError(f"serving returned {buf[:30]!r}")
                 head = buf[:end].lower()
                 i = head.find(_CL)
                 if i < 0:
@@ -153,13 +168,16 @@ def _drive(port: int, n_users: int, clients: int, requests: int):
         latencies = list(ex.map(one, reqs))
     wall = time.perf_counter() - t0
     lat = np.array(latencies)
-    return {
+    out = {
         "throughput_rps": round(requests / wall, 1),
         "p50_unloaded_ms": round(float(np.percentile(unloaded, 50)), 2),
         "p50_ms": round(float(np.percentile(lat, 50)), 2),
         "p95_ms": round(float(np.percentile(lat, 95)), 2),
         "p99_ms": round(float(np.percentile(lat, 99)), 2),
     }
+    if count_non_2xx:
+        out["predict_non_2xx"] = len(non_2xx)
+    return out
 
 
 _BUCKET_RE = re.compile(
@@ -225,18 +243,51 @@ def main():
         # the pair is the tail-latency-under-partial-failure record.
         # Installed AFTER setup+clean so the plan targets only the
         # faulted serving phase, not data load / training / baseline.
+        # A /reload is attempted before AND during the faulted drive:
+        # with the storage faulted the reload must fail CLOSED (503,
+        # breaker trips) while every predict keeps answering from the
+        # last-good in-memory model — predict_non_2xx records the claim.
         os.environ["PIO_FAULTS"] = args.faults
-        faulted = _drive(srv.port, n_users, args.clients, args.requests)
+
+        def _try_reload():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/reload", data=b"",
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+            except OSError:
+                return -1
+
+        reload_before = _try_reload()
+        mid = {}
+        timer = threading.Timer(
+            0.3, lambda: mid.update(status=_try_reload()))
+        timer.start()
+        faulted = _drive(srv.port, n_users, args.clients, args.requests,
+                         count_non_2xx=True)
+        timer.join()
         # Uninstall before the native section below: its line carries no
         # faults marker, so it must actually run clean.
         os.environ.pop("PIO_FAULTS", None)
+        gen = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=10).read())
         srv.stop()
         delta = {}
         for k in ("p50_ms", "p99_ms"):
             if k in res and k in faulted:
                 delta[f"{k}_delta"] = round(faulted[k] - res[k], 2)
-        print(json.dumps({"frontend": "python", "faults": args.faults,
-                          "clean": res, "faulted": faulted, **delta}))
+        print(json.dumps({
+            "frontend": "python", "faults": args.faults,
+            "clean": res, "faulted": faulted, **delta,
+            "reload_status_before_drive": reload_before,
+            "reload_status_mid_drive": mid.get("status"),
+            "predict_non_2xx_during_outage": faulted.get("predict_non_2xx"),
+            "model_generation": gen.get("modelGeneration"),
+            "breaker": gen.get("breaker"),
+        }))
     else:
         srv.stop()
         print(json.dumps({"frontend": "python", **res}))

@@ -127,6 +127,7 @@ def _print_metrics_snapshot(metrics_url: Optional[str]) -> None:
         except Exception as e:
             print(f"metrics: cannot scrape {url} ({e})")
             return
+        _print_serving_snapshot(text.splitlines())
         print(f"metrics (scraped from {url}):")
         for line in text.splitlines():
             if line and not line.startswith("#"):
@@ -140,9 +141,74 @@ def _print_metrics_snapshot(metrics_url: Optional[str]) -> None:
         print("metrics: none recorded in this process "
               "(use --metrics-url http://HOST:PORT to scrape a server)")
         return
+    _print_serving_snapshot(samples)
     print("metrics (this process):")
     for line in samples:
         print(f"  {line}")
+
+
+_BREAKER_STATES = {0: "closed", 1: "half-open", 2: "open"}
+_METRIC_LINE = None  # compiled lazily (keep the import-light CLI startup)
+
+
+def _parse_metric_lines(lines):
+    """(name, labels-dict, value) triples from Prometheus text lines."""
+    import re
+
+    global _METRIC_LINE
+    if _METRIC_LINE is None:
+        _METRIC_LINE = re.compile(
+            r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$')
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _METRIC_LINE.match(line)
+        if not m:
+            continue
+        labels = {}
+        for part in (m.group("labels") or "").split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        try:
+            yield m.group("name"), labels, float(m.group("value"))
+        except ValueError:
+            continue
+
+
+def _print_serving_snapshot(lines) -> None:
+    """Model-lifecycle view for `pio status` (ISSUE 4 satellite): the
+    serving generation, reload outcomes, and breaker states out of a
+    metrics exposition — printed alongside the device-memory snapshot so
+    one `pio status --metrics-url` answers "what model is live and is
+    its storage healthy"."""
+    generation = None
+    reloads = {}
+    breakers = {}
+    watchdog = {}
+    for name, labels, value in _parse_metric_lines(lines):
+        if name == "pio_model_generation":
+            generation = int(value)
+        elif name == "pio_model_reload_total":
+            reloads[labels.get("result", "?")] = int(value)
+        elif name == "pio_breaker_state":
+            breakers[labels.get("breaker", "?")] = \
+                _BREAKER_STATES.get(int(value), str(value))
+        elif name == "pio_watchdog_fired_total" and value > 0:
+            watchdog[labels.get("fn", "?")] = int(value)
+    if generation is None and not reloads and not breakers:
+        return
+    if generation is not None:
+        print(f"serving: model generation {generation}")
+    if reloads:
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(reloads.items()))
+        print(f"  model reloads: {parts}")
+    for b, st in sorted(breakers.items()):
+        print(f"  breaker [{b}]: {st}")
+    for fn, n in sorted(watchdog.items()):
+        print(f"  watchdog fired [{fn}]: {n}")
 
 
 # --------------------------------------------------------------------------
@@ -306,9 +372,18 @@ def cmd_accesskey_delete(args) -> int:
 def cmd_train(args) -> int:
     from predictionio_tpu.controller import EngineVariant, RuntimeContext, load_engine_factory
     from predictionio_tpu.parallel.distributed import initialize_distributed
+    from predictionio_tpu.resilience.supervision import (
+        PREEMPTED_EXIT_CODE,
+        TrainPreempted,
+        install_preemption_handler,
+    )
     from predictionio_tpu.workflow import run_train
 
     initialize_distributed()
+    # SIGTERM during training → final checkpoint + exit 143 (preemption
+    # contract, README "Training supervision"): the supervisor's rerun
+    # resumes via --checkpoint-dir.
+    install_preemption_handler()
     if getattr(args, "checkpoint_dir", None):
         if args.checkpoint_every <= 0:
             _die("--checkpoint-dir requires --checkpoint-every N (the save "
@@ -327,7 +402,13 @@ def cmd_train(args) -> int:
     ctx = RuntimeContext.create(seed=args.seed, mesh_spec=args.mesh)
     if ctx.mesh is not None:
         print(f"Mesh: {dict(ctx.mesh.shape)} over {ctx.mesh.devices.size} device(s)")
-    instance_id = run_train(engine, variant, ctx)
+    try:
+        instance_id = run_train(engine, variant, ctx)
+    except TrainPreempted as e:
+        print(f"[preempted] {e}", file=sys.stderr)
+        print("[preempted] rerun the same `pio train` command to resume.",
+              file=sys.stderr)
+        return PREEMPTED_EXIT_CODE
     print(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 
@@ -716,6 +797,94 @@ def cmd_dashboard(args) -> int:
 
 
 # --------------------------------------------------------------------------
+# pio spill — manual spill-journal operations (ISSUE 4 satellite: the
+# stopgap for ROADMAP resilience follow-on (b) until shared-queue spill)
+# --------------------------------------------------------------------------
+
+def _spill_dir(args) -> "Path":
+    from predictionio_tpu.config import load_config
+    from predictionio_tpu.resilience.spill import resolve_spill_dir
+
+    d = resolve_spill_dir(getattr(args, "dir", None), load_config().home)
+    if d is None:
+        _die("spilling is disabled (PIO_SPILL_DIR=off and no --dir given).")
+    return d
+
+
+def cmd_spill_inspect(args) -> int:
+    from predictionio_tpu.resilience.spill import journal_summary
+
+    s = journal_summary(_spill_dir(args))
+    print(f"spill journal: {s['dir']}")
+    print(f"  pending: {s['pendingRecords']} record(s) / "
+          f"{s['pendingEvents']} event(s) "
+          f"(offset {s['replayedOffset']}/{s['records']})")
+    if s["pendingTokens"]:
+        print(f"  next tokens: {', '.join(t or '-' for t in s['pendingTokens'])}")
+    print(f"  dead-lettered: {s['deadRecords']} record(s) / "
+          f"{s['deadEvents']} event(s)")
+    for inst in s["privateInstanceDirs"]:
+        print(f"  private instance dir (locked-journal divert): {inst}")
+    if args.json:
+        print(json.dumps(s))
+    return 0
+
+
+def _open_spill_exclusive(args):
+    """The mutating verbs need THE journal, not a diverted private one."""
+    from predictionio_tpu.resilience.spill import SpillJournal
+
+    try:
+        return SpillJournal(_spill_dir(args), divert_if_locked=False)
+    except RuntimeError as e:
+        _die(str(e))
+
+
+def cmd_spill_drain(args) -> int:
+    """Foreground replay of the pending journal into storage — the same
+    record-at-a-time, token-pinned insert the event server's background
+    worker does, for when that server is gone (crashed box, decommission)
+    but its journal must not be."""
+    from predictionio_tpu.data.json_support import event_from_json
+    from predictionio_tpu.resilience import idempotency_key
+    from predictionio_tpu.resilience.spill import ReplayWorker
+
+    journal = _open_spill_exclusive(args)
+    storage = _storage()
+
+    def insert(record):
+        evs = [event_from_json(e) for e in record["events"]]
+        with idempotency_key(record["token"]):
+            storage.get_events().insert_batch(evs, record["appId"],
+                                              record.get("channelId"))
+
+    worker = ReplayWorker(journal, insert, batch=args.batch)
+    try:
+        landed = worker.drain_once()
+        remaining = journal.depth()
+    finally:
+        journal.close()
+    print(f"Replayed {landed} event(s); {remaining} still pending"
+          + (" (storage unavailable — re-run after recovery)."
+             if remaining else "."))
+    return 0 if remaining == 0 else 1
+
+
+def cmd_spill_requeue_dead(args) -> int:
+    journal = _open_spill_exclusive(args)
+    try:
+        n = journal.requeue_dead()
+    finally:
+        journal.close()
+    if n == 0:
+        print("No dead-lettered records.")
+    else:
+        print(f"Requeued {n} dead-lettered event(s) for replay "
+              "(drain with `pio spill drain` or restart the event server).")
+    return 0
+
+
+# --------------------------------------------------------------------------
 # pio import / export
 # --------------------------------------------------------------------------
 
@@ -955,6 +1124,30 @@ def build_parser() -> argparse.ArgumentParser:
                     help="artifact directory (default: fresh temp dir; "
                          "env PIO_PROFILE_OUT)")
     pf.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("spill", help="inspect/drain the storage-outage "
+                                      "spill journal")
+    spsub = sp.add_subparsers(dest="spill_verb", required=True)
+    si = spsub.add_parser("inspect", help="pending/dead-letter counts "
+                                          "(read-only; safe while the "
+                                          "event server runs)")
+    si.add_argument("--dir", default=None,
+                    help="journal directory (default: PIO_SPILL_DIR, "
+                         "else $PIO_HOME/spill)")
+    si.add_argument("--json", action="store_true",
+                    help="also print the summary as one JSON line")
+    si.set_defaults(fn=cmd_spill_inspect)
+    sd = spsub.add_parser("drain", help="foreground replay into storage "
+                                        "(event server must be stopped)")
+    sd.add_argument("--dir", default=None)
+    sd.add_argument("--batch", type=int, default=100,
+                    help="records per replay batch")
+    sd.set_defaults(fn=cmd_spill_drain)
+    sq = spsub.add_parser("requeue-dead",
+                          help="move dead-lettered records back into the "
+                               "journal for replay")
+    sq.add_argument("--dir", default=None)
+    sq.set_defaults(fn=cmd_spill_requeue_dead)
 
     imp = sub.add_parser("import", help="import NDJSON events")
     imp.add_argument("--appid", type=int, required=True)

@@ -31,7 +31,11 @@ from predictionio_tpu.data.storage.base import (  # re-export
     StorageError,
     StorageUnavailable,
 )
-from predictionio_tpu.resilience.faults import wrap_events as _wrap_events
+from predictionio_tpu.resilience.faults import (
+    wrap_events as _wrap_events,
+    wrap_instances as _wrap_instances,
+    wrap_models as _wrap_models,
+)
 
 __all__ = [
     "Storage",
@@ -237,14 +241,17 @@ class Storage:
         return self._backend_for("METADATA").channels()
 
     def get_engine_instances(self) -> EngineInstances:
-        return self._backend_for("METADATA").engine_instances()
+        # Fault seam like get_events: lets PIO_FAULTS storage.* rules
+        # break the engine server's reload reads (ISSUE 4 fail-closed).
+        return _wrap_instances(
+            self._backend_for("METADATA").engine_instances())
 
     def get_evaluation_instances(self) -> EvaluationInstances:
         return self._backend_for("METADATA").evaluation_instances()
 
     # MODELDATA
     def get_models(self) -> Models:
-        return self._backend_for("MODELDATA").models()
+        return _wrap_models(self._backend_for("MODELDATA").models())
 
     def close(self) -> None:
         with self._lock:

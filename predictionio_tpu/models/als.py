@@ -992,6 +992,14 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
     resumed result is bitwise equal to an uninterrupted run
     (SURVEY.md §5.4: resume is a capability the reference lacks;
     tests/test_checkpoint_resume.py pins the equality).
+
+    Supervision (resilience/supervision.py): each sweep chunk's factors
+    are finiteness-checked before they can be checkpointed — a
+    non-finite chunk rolls back to the last-good sweep (bounded by
+    ``PIO_DIVERGENCE_RETRIES``, then ``TrainDiverged``); SIGTERM
+    preemption force-saves the current sweep and raises
+    ``TrainPreempted``; ``PIO_STEP_TIMEOUT_S`` arms a watchdog around
+    every device dispatch (one ``sweeps()`` call).
     """
     k = config.rank
     uf, itf = inputs.uf0, inputs.itf0
@@ -1033,6 +1041,17 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
             uf, itf, ubk, ibk, reg, alpha, jnp.int32(n),
             factor_shardings=factor_shardings, **statics)
 
+    from predictionio_tpu.resilience.supervision import (
+        DivergenceGuard,
+        RollbackRequested,
+        StepWatchdog,
+        TrainDiverged,
+        TrainPreempted,
+        all_finite,
+        preemption_requested,
+    )
+
+    guard = DivergenceGuard("als")
     if checkpoint_dir and save_every > 0:
         from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
 
@@ -1041,18 +1060,60 @@ def train_als_prepared(inputs: ALSInputs, config: ALSConfig, *,
         fp = f"als|{config}|{inputs.n_users}x{inputs.n_items}"
         ckpt = TrainCheckpointer(checkpoint_dir, save_every=save_every,
                                  fingerprint=fp)
-        done = ckpt.restore_step((uf, itf), total_steps=config.iterations)
-        if ckpt.restored_state is not None:
-            uf, itf = ckpt.restored_state
-        while done < config.iterations:
-            n = min(save_every, config.iterations - done)
-            uf, itf = sweeps(uf, itf, n)
-            done += n
-            ckpt.maybe_save(done, (uf, itf))
-        ckpt.complete()
-        ckpt.close()
+        watchdog = StepWatchdog("als", checkpoint_fn=ckpt.flush)
+        try:
+            done = ckpt.restore_step((uf, itf), total_steps=config.iterations)
+            if ckpt.restored_state is not None:
+                uf, itf = ckpt.restored_state
+            while done < config.iterations:
+                n = min(save_every, config.iterations - done)
+                watchdog.arm(done + n)
+                uf2, itf2 = sweeps(uf, itf, n)
+                finite = all_finite((uf2, itf2))  # forces the dispatch
+                watchdog.disarm()
+                if not finite:
+                    # Rollback IN PLACE: re-restore the latest durable
+                    # sweep (or the factor init when none exists) and
+                    # replay.  The sweep math is index-independent, so a
+                    # replayed chunk is the same program.  diverged()
+                    # raises TrainDiverged once the retries are spent.
+                    try:
+                        guard.diverged(done + n, "non-finite factors")
+                    except RollbackRequested:
+                        pass
+                    ckpt.restored_state = None
+                    done = ckpt.restore_step((uf, itf),
+                                             total_steps=config.iterations)
+                    if ckpt.restored_state is not None:
+                        uf, itf = ckpt.restored_state
+                    else:
+                        uf, itf = inputs.uf0, inputs.itf0
+                        done = 0
+                    continue
+                uf, itf = uf2, itf2
+                done += n
+                saved = ckpt.maybe_save(done, (uf, itf))
+                if preemption_requested():
+                    if not saved:
+                        ckpt.save(done, (uf, itf))
+                    ckpt.flush()
+                    raise TrainPreempted("als", done, True)
+            ckpt.complete()
+        finally:
+            watchdog.stop()
+            ckpt.close()
     else:
-        uf, itf = sweeps(uf, itf, config.iterations)
+        watchdog = StepWatchdog("als")
+        watchdog.arm(int(config.iterations))
+        try:
+            uf, itf = sweeps(uf, itf, config.iterations)
+            # No checkpoint to roll back to: a non-finite result is a
+            # terminal divergence (never silently returned/persisted).
+            if not all_finite((uf, itf)):
+                raise TrainDiverged("als", int(config.iterations),
+                                    "non-finite factors", 0)
+        finally:
+            watchdog.stop()
     # Blocked mode pads factor rows to the mesh axis size; the model keeps
     # the true extents.
     if uf.shape[0] != inputs.n_users:

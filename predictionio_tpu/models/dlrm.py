@@ -268,7 +268,50 @@ def train(
     "auto" picks the feeder whenever the native library builds.
     ``checkpoint_dir`` + ``save_every`` give mid-training resume with
     deterministic per-(seed, epoch) batch order in both sources.
+
+    Supervision mirrors two_tower.train: divergence rollback to the
+    last-good checkpoint (bounded, then ``TrainDiverged``), SIGTERM
+    preemption (``TrainPreempted`` after a final checkpoint), and the
+    ``PIO_STEP_TIMEOUT_S`` step watchdog.
     """
+    from predictionio_tpu.resilience.supervision import (
+        DivergenceGuard,
+        RollbackRequested,
+    )
+
+    # Without a checkpointer a "rollback" is a full deterministic retrain
+    # that reproduces the same NaN — terminal immediately (max 0), same
+    # policy as als.py.
+    can_rollback = bool(checkpoint_dir) and save_every > 0
+    guard = DivergenceGuard("dlrm",
+                            max_rollbacks=None if can_rollback else 0)
+    while True:
+        try:
+            return _train_attempt(dense, cat, labels, cfg, mesh,
+                                  checkpoint_dir=checkpoint_dir,
+                                  save_every=save_every,
+                                  data_source=data_source, guard=guard)
+        except RollbackRequested:
+            continue  # re-enter: restore_step fast-forwards to last-good
+
+
+def _train_attempt(
+    dense: np.ndarray,
+    cat: np.ndarray,
+    labels: np.ndarray,
+    cfg: DLRMConfig,
+    mesh: Optional[Mesh],
+    *,
+    checkpoint_dir,
+    save_every: int,
+    data_source: str,
+    guard,
+) -> DLRMState:
+    from predictionio_tpu.resilience.supervision import (
+        StepWatchdog,
+        TrainPreempted,
+        preemption_requested,
+    )
     from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
 
     n = len(labels)
@@ -279,6 +322,7 @@ def train(
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
                              if checkpoint_dir else 0,
                              fingerprint=f"dlrm|{cfg}|n={n}")
+    watchdog = StepWatchdog("dlrm", checkpoint_fn=ckpt.flush)
     start_step = ckpt.restore_step(
         (state.params, state.opt_state, state.step), total_steps=total_steps)
     if ckpt.restored_state is not None:
@@ -328,31 +372,54 @@ def train(
 
     probe = PipelineProbe("dlrm")
     global_step = 0
-    for d, c, y in probe.iter_host(
-            feeder_epochs() if use_feeder else numpy_epochs()):
-        global_step += 1
-        if global_step <= start_step:
-            continue  # resume fast-forward: batch already trained
-        n_real = len(y)
-        with probe.h2d():
-            pad = bs - len(y)
-            d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
-            c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
-            w = np.concatenate([np.ones(len(y), np.float32),
-                                np.zeros(pad, np.float32)])
-            y = np.concatenate([y, np.zeros(pad, np.float32)])
-            args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
-                    jnp.asarray(y, jnp.float32), jnp.asarray(w)]
-            if sh is not None:
-                args = [put_sharded(a, mesh, sh) for a in args]
-        probe.sync()  # wait on step N-1 here: its state feeds step N
-        state, _ = train_step(state, *args, cfg, mesh)
-        probe.dispatched(state, examples=n_real)
-        ckpt.maybe_save(global_step,
-                        (state.params, state.opt_state, state.step))
-    probe.finish()
-    ckpt.complete()
-    ckpt.close()
+    loss = None
+    try:
+        for d, c, y in probe.iter_host(
+                feeder_epochs() if use_feeder else numpy_epochs()):
+            global_step += 1
+            if global_step <= start_step:
+                continue  # resume fast-forward: batch already trained
+            n_real = len(y)
+            with probe.h2d():
+                pad = bs - len(y)
+                d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
+                c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
+                w = np.concatenate([np.ones(len(y), np.float32),
+                                    np.zeros(pad, np.float32)])
+                y = np.concatenate([y, np.zeros(pad, np.float32)])
+                args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
+                        jnp.asarray(y, jnp.float32), jnp.asarray(w)]
+                if sh is not None:
+                    args = [put_sharded(a, mesh, sh) for a in args]
+            watchdog.arm(global_step)
+            probe.sync()  # wait on step N-1 here: its state feeds step N
+            if loss is not None:
+                guard.check(loss, global_step - 1)
+            state, loss = train_step(state, *args, cfg, mesh)
+            probe.dispatched(state, examples=n_real)
+            saved = False
+            if ckpt.enabled and global_step % ckpt.save_every == 0:
+                # Fresh watchdog deadline: the forced loss check blocks
+                # on the device and a hang here must fire too.
+                watchdog.arm(global_step)
+                guard.check(loss, global_step)  # never checkpoint a NaN state
+                saved = ckpt.maybe_save(
+                    global_step, (state.params, state.opt_state, state.step))
+            watchdog.disarm()
+            if preemption_requested():
+                if ckpt.enabled and not saved:
+                    ckpt.save(global_step,
+                              (state.params, state.opt_state, state.step))
+                ckpt.flush()
+                raise TrainPreempted("dlrm", global_step, ckpt.enabled)
+        probe.finish()
+        if loss is not None:
+            guard.check(loss, global_step)
+        guard.check_params(state.params, global_step)
+        ckpt.complete()
+    finally:
+        watchdog.stop()
+        ckpt.close()
     return state
 
 

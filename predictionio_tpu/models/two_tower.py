@@ -230,7 +230,52 @@ def train(
     once per epoch with a deterministic per-(seed, epoch) shuffle; only
     the permutation differs (tests/test_native.py pins feeder-vs-numpy
     training equivalence).
+
+    Supervision (resilience/supervision.py): a non-finite loss rolls the
+    run back to the last-good checkpoint (bounded retries, then
+    ``TrainDiverged`` — a NaN model is never returned/persisted);
+    SIGTERM preemption checkpoints and raises ``TrainPreempted``; with
+    ``PIO_STEP_TIMEOUT_S`` set, a hung device step fires the watchdog
+    instead of blocking forever.
     """
+    from predictionio_tpu.resilience.supervision import (
+        DivergenceGuard,
+        RollbackRequested,
+    )
+
+    # Without a checkpointer a "rollback" is a full deterministic retrain
+    # that reproduces the same NaN — terminal immediately (max 0), same
+    # policy as als.py.
+    can_rollback = bool(checkpoint_dir) and save_every > 0
+    guard = DivergenceGuard("two_tower",
+                            max_rollbacks=None if can_rollback else 0)
+    while True:
+        try:
+            return _train_attempt(user_ids, item_ids, cfg, mesh, weights,
+                                  checkpoint_dir=checkpoint_dir,
+                                  save_every=save_every,
+                                  data_source=data_source, guard=guard)
+        except RollbackRequested:
+            continue  # re-enter: restore_step fast-forwards to last-good
+
+
+def _train_attempt(
+    user_ids: np.ndarray,
+    item_ids: np.ndarray,
+    cfg: TwoTowerConfig,
+    mesh: Optional[Mesh],
+    weights: Optional[np.ndarray],
+    *,
+    checkpoint_dir,
+    save_every: int,
+    data_source: str,
+    guard,
+) -> TwoTowerState:
+    from predictionio_tpu.resilience.supervision import (
+        StepWatchdog,
+        TrainPreempted,
+        preemption_requested,
+    )
     from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
 
     n = len(user_ids)
@@ -241,6 +286,7 @@ def train(
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
                              if checkpoint_dir else 0,
                              fingerprint=f"two_tower|{cfg}|n={n}")
+    watchdog = StepWatchdog("two_tower", checkpoint_fn=ckpt.flush)
     start_step = ckpt.restore_step(
         (state.params, state.opt_state, state.step), total_steps=total_steps)
     if ckpt.restored_state is not None:
@@ -281,32 +327,62 @@ def train(
 
     probe = PipelineProbe("two_tower")
     global_step = 0
-    for u, i, w in probe.iter_host(
-            feeder_epochs() if use_feeder else numpy_epochs()):
-        global_step += 1
-        if global_step <= start_step:
-            continue  # resume fast-forward: batch already trained
-        n_real = len(u)
-        with probe.h2d():
-            pad = bs - len(u)
-            u = np.concatenate([np.asarray(u, np.int64),
-                                np.zeros(pad, np.int64)])
-            i = np.concatenate([np.asarray(i, np.int64),
-                                np.zeros(pad, np.int64)])
-            w = np.concatenate([np.asarray(w, np.float32),
-                                np.zeros(pad, np.float32)])
-            args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
-            if batch_sharding is not None:
-                args = tuple(put_sharded(a, mesh, batch_sharding)
-                             for a in args)
-        probe.sync()  # wait on step N-1 here: its state feeds step N
-        state, _ = train_step(state, *args, cfg)
-        probe.dispatched(state, examples=n_real)
-        ckpt.maybe_save(global_step,
-                        (state.params, state.opt_state, state.step))
-    probe.finish()
-    ckpt.complete()
-    ckpt.close()
+    loss = None
+    try:
+        for u, i, w in probe.iter_host(
+                feeder_epochs() if use_feeder else numpy_epochs()):
+            global_step += 1
+            if global_step <= start_step:
+                continue  # resume fast-forward: batch already trained
+            n_real = len(u)
+            with probe.h2d():
+                pad = bs - len(u)
+                u = np.concatenate([np.asarray(u, np.int64),
+                                    np.zeros(pad, np.int64)])
+                i = np.concatenate([np.asarray(i, np.int64),
+                                    np.zeros(pad, np.int64)])
+                w = np.concatenate([np.asarray(w, np.float32),
+                                    np.zeros(pad, np.float32)])
+                args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
+                if batch_sharding is not None:
+                    args = tuple(put_sharded(a, mesh, batch_sharding)
+                                 for a in args)
+            watchdog.arm(global_step)
+            probe.sync()  # wait on step N-1 here: its state feeds step N
+            if loss is not None:
+                # Step N-1's loss materialized with the sync above — the
+                # finiteness check costs one float().
+                guard.check(loss, global_step - 1)
+            state, loss = train_step(state, *args, cfg)
+            probe.dispatched(state, examples=n_real)
+            saved = False
+            if ckpt.enabled and global_step % ckpt.save_every == 0:
+                # Never checkpoint unvalidated state: force this step's
+                # loss (rare — only at the save cadence) so a rollback
+                # target is always finite.  Re-armed with a fresh
+                # deadline first: this float() blocks on the device, and
+                # a hang HERE must fire the watchdog too.
+                watchdog.arm(global_step)
+                guard.check(loss, global_step)
+                saved = ckpt.maybe_save(
+                    global_step, (state.params, state.opt_state, state.step))
+            watchdog.disarm()
+            if preemption_requested():
+                if ckpt.enabled and not saved:
+                    ckpt.save(global_step,
+                              (state.params, state.opt_state, state.step))
+                ckpt.flush()
+                raise TrainPreempted("two_tower", global_step, ckpt.enabled)
+        probe.finish()
+        if loss is not None:
+            guard.check(loss, global_step)
+        guard.check_params(state.params, global_step)
+        ckpt.complete()
+    finally:
+        # Close on EVERY path: a rollback re-entry reopens the directory
+        # and must not race this attempt's in-flight async saves.
+        watchdog.stop()
+        ckpt.close()
     return state
 
 

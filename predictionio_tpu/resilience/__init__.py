@@ -20,6 +20,12 @@ every network hop (SDK → event/engine servers → RemoteClient):
   journal: a durable append-only JSONL file the event server degrades
   into (202 + ``Retry-After``) plus the background replay worker that
   drains it on recovery.
+- :mod:`predictionio_tpu.resilience.supervision` — run supervision for
+  the model lifecycle: step watchdog (``PIO_STEP_TIMEOUT_S``),
+  divergence rollback (``PIO_DIVERGENCE_RETRIES``), SIGTERM preemption
+  (``pio train`` exits :data:`~supervision.PREEMPTED_EXIT_CODE` after a
+  final checkpoint), and the finite-model validation behind the engine
+  server's staged reload.
 
 Idempotency tokens make remote-storage writes *safely* retriable: the
 JSON-RPC client stamps every write with a client-generated token, the
@@ -53,6 +59,18 @@ from predictionio_tpu.resilience.policy import (
     RetryPolicy,
 )
 from predictionio_tpu.resilience.spill import ReplayWorker, SpillJournal
+from predictionio_tpu.resilience.supervision import (
+    PREEMPTED_EXIT_CODE,
+    DivergenceGuard,
+    ModelValidationError,
+    StepWatchdog,
+    TrainDiverged,
+    TrainPreempted,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+    validate_model_finite,
+)
 
 __all__ = [
     "CircuitBreaker",
@@ -69,6 +87,16 @@ __all__ = [
     "SpillJournal",
     "idempotency_key",
     "current_idempotency_key",
+    "PREEMPTED_EXIT_CODE",
+    "DivergenceGuard",
+    "ModelValidationError",
+    "StepWatchdog",
+    "TrainDiverged",
+    "TrainPreempted",
+    "install_preemption_handler",
+    "preemption_requested",
+    "request_preemption",
+    "validate_model_finite",
 ]
 
 

@@ -53,6 +53,8 @@ __all__ = [
     "active",
     "fault_point",
     "wrap_events",
+    "wrap_instances",
+    "wrap_models",
 ]
 
 
@@ -222,17 +224,18 @@ _EVENTS_POINTS = {
 }
 
 
-class _FaultyEvents:
+class _FaultyRepo:
     """Transparent proxy running a fault point before each repo call."""
 
-    def __init__(self, inner: Any):
+    def __init__(self, inner: Any, points: dict):
         self._inner = inner
+        self._points = points
 
     def __getattr__(self, attr: str) -> Any:
         val = getattr(self._inner, attr)
         if not callable(val):
             return val
-        point = _EVENTS_POINTS.get(attr, f"storage.{attr}")
+        point = self._points.get(attr, f"storage.{attr}")
 
         def wrapped(*args, **kwargs):
             fault_point(point)
@@ -248,4 +251,38 @@ def wrap_events(events: Any) -> Any:
     installed mid-process takes effect without rebuilding storage)."""
     if _current_plan() is None:
         return events
-    return _FaultyEvents(events)
+    return _FaultyRepo(events, _EVENTS_POINTS)
+
+
+# Model-lifecycle repositories (ISSUE 4: the engine server's staged
+# reload reads engine instances + model blobs — "storage.find:error"
+# must be able to break a reload so fail-closed serving is testable).
+_INSTANCES_POINTS = {
+    "get": "storage.find",
+    "get_all": "storage.find",
+    "get_latest_completed": "storage.find",
+    "get_completed": "storage.find",
+    "insert": "storage.create",
+    "update": "storage.create",
+    "delete": "storage.delete",
+}
+
+_MODELS_POINTS = {
+    "get": "storage.find",
+    "insert": "storage.create",
+    "delete": "storage.delete",
+}
+
+
+def wrap_instances(instances: Any) -> Any:
+    """Fault seam over an EngineInstances repository (reload reads)."""
+    if _current_plan() is None:
+        return instances
+    return _FaultyRepo(instances, _INSTANCES_POINTS)
+
+
+def wrap_models(models: Any) -> Any:
+    """Fault seam over a Models (blob store) repository (reload reads)."""
+    if _current_plan() is None:
+        return models
+    return _FaultyRepo(models, _MODELS_POINTS)

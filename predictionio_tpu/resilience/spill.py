@@ -51,7 +51,8 @@ from predictionio_tpu.resilience.policy import CircuitOpenError
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["SpillJournal", "ReplayWorker", "resolve_spill_dir"]
+__all__ = ["SpillJournal", "ReplayWorker", "resolve_spill_dir",
+           "journal_summary"]
 
 _DISABLED = ("off", "none", "disabled", "0")
 
@@ -72,9 +73,14 @@ class SpillJournal:
     """Durable append-only JSONL queue with a persisted replay offset.
 
     One record per failed write; ``depth()`` counts pending EVENTS (what
-    operators care about), the offset counts records."""
+    operators care about), the offset counts records.
 
-    def __init__(self, directory: Path, registry=None):
+    ``divert_if_locked=False`` (the ``pio spill`` manual-ops path) turns
+    the locked-directory divert into a hard error instead — an operator
+    draining a journal wants THE journal, not a fresh private one."""
+
+    def __init__(self, directory: Path, registry=None, *,
+                 divert_if_locked: bool = True):
         base = Path(directory)
         base.mkdir(parents=True, exist_ok=True)
         # Cross-process exclusion: the journal format assumes a SINGLE
@@ -83,6 +89,7 @@ class SpillJournal:
         # private instance-<pid>-<rand> subdirectory so neither can
         # truncate under the other or double-replay the same records.
         self._lock_f = None
+        self._divert_if_locked = divert_if_locked
         self.dir = self._acquire_dir(base)
         self.path = self.dir / "spill.jsonl"
         self.offset_path = self.dir / "spill.offset"
@@ -131,6 +138,11 @@ class SpillJournal:
             return base
         except OSError:
             f.close()
+        if not self._divert_if_locked:
+            raise RuntimeError(
+                f"spill journal {base} is locked by a running event "
+                "server — stop it (or point --dir at its private "
+                "instance-* directory) before draining/requeueing")
         inst = base / f"instance-{os.getpid()}-{uuid.uuid4().hex[:8]}"
         inst.mkdir(parents=True, exist_ok=True)
         logger.warning(
@@ -303,6 +315,42 @@ class SpillJournal:
         publish_event("spill.dead_letter", token=record.get("token"),
                       events=len(record["events"]), reason=reason)
 
+    def dead_records(self) -> List[Dict[str, Any]]:
+        """Parse the dead-letter file (operator inspection/requeue)."""
+        if not self.dead_path.exists():
+            return []
+        out: List[Dict[str, Any]] = []
+        with open(self.dead_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    logger.warning("spill dead-letter file has an "
+                                   "unparseable line; skipping it")
+        return out
+
+    def requeue_dead(self) -> int:
+        """Move every dead-lettered record back into the live journal
+        (``pio spill requeue-dead`` — after the operator fixed whatever
+        made replay reject them).  Each record re-queues under its
+        ORIGINAL idempotency token, so a record that was dead-lettered
+        for a transient miscategorized as permanent still dedups.
+        Returns the number of EVENTS requeued."""
+        records = self.dead_records()
+        n_events = 0
+        for rec in records:
+            self.append(rec["events"], rec["appId"], rec.get("channelId"),
+                        token=rec.get("token"))
+            n_events += len(rec["events"])
+        if records:
+            self.dead_path.unlink()
+            publish_event("spill.requeue_dead", records=len(records),
+                          events=n_events)
+        return n_events
+
     def close(self) -> None:
         with self._lock:
             try:
@@ -314,6 +362,63 @@ class SpillJournal:
             if self._lock_f is not None:
                 self._lock_f.close()  # releases the flock
                 self._lock_f = None
+
+
+def journal_summary(directory: Path) -> Dict[str, Any]:
+    """Read-only spill-journal summary (``pio spill inspect``) — parses
+    the files directly, takes NO lock, never mutates: safe to run while
+    the owning event server is live (the numbers are a point-in-time
+    snapshot)."""
+    d = Path(directory)
+    path, offset_path, dead_path = (d / "spill.jsonl", d / "spill.offset",
+                                    d / "spill.dead.jsonl")
+    offset = 0
+    if offset_path.exists():
+        try:
+            offset = int(offset_path.read_text().strip() or 0)
+        except ValueError:
+            offset = 0
+    records = pending_events = 0
+    tokens: List[str] = []
+    if path.exists():
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n") or not line.strip():
+                    continue  # torn tail / blank
+                try:
+                    rec = json.loads(line)
+                    n = len(rec["events"])
+                except (ValueError, KeyError, TypeError):
+                    continue
+                records += 1
+                if records > offset:
+                    pending_events += n
+                    if len(tokens) < 5:
+                        tokens.append(rec.get("token"))
+    dead_records = dead_events = 0
+    if dead_path.exists():
+        with open(dead_path, "rb") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                dead_records += 1
+                dead_events += len(rec.get("events", []))
+    instances = sorted(p.name for p in d.glob("instance-*") if p.is_dir())
+    return {
+        "dir": str(d),
+        "records": records,
+        "replayedOffset": min(offset, records),
+        "pendingRecords": max(records - offset, 0),
+        "pendingEvents": pending_events,
+        "pendingTokens": tokens,
+        "deadRecords": dead_records,
+        "deadEvents": dead_events,
+        "privateInstanceDirs": instances,
+    }
 
 
 # Replay failures that mean "storage still down, try again next tick" —

@@ -1,0 +1,449 @@
+"""Run supervision: the crash-safe model-lifecycle layer.
+
+The reference's availability story is actor supervision — MasterActor
+restarts a wedged ServerActor and swaps models after retrain.  The Python
+rebuild replaced that with a bare swap-under-lock and nothing watching
+the training loop at all: a hung device step blocked ``pio train``
+forever, a NaN'd run persisted straight into serving, and a SIGTERM'd
+train threw away its progress.  This module is the supervision half of
+PR 2's resilience subsystem, wired through both sides of the model
+lifecycle:
+
+Training side (models/two_tower.py, models/dlrm.py, models/als.py):
+
+- :class:`StepWatchdog` — a device step exceeding ``PIO_STEP_TIMEOUT_S``
+  fires ``pio_watchdog_fired_total{fn}``, publishes a ``watchdog.fired``
+  trace-ring event carrying the last step-timeline entry, flushes any
+  pending async checkpoint saves (so the resume point is durable), and
+  aborts the run instead of hanging forever.  Injectable clock — the
+  test matrix runs on fakes with no wall sleeps.
+- :class:`DivergenceGuard` — a non-finite loss or parameter tree rolls
+  the run back to the last-good :class:`TrainCheckpointer` step, at most
+  ``PIO_DIVERGENCE_RETRIES`` times, then raises :class:`TrainDiverged`.
+  A NaN model is never silently persisted.
+- Preemption — ``SIGTERM`` during ``pio train`` sets a process-wide flag
+  (:func:`install_preemption_handler`); the loops notice at the next
+  step boundary, write a final checkpoint, and raise
+  :class:`TrainPreempted`, which the CLI maps to exit code
+  :data:`PREEMPTED_EXIT_CODE` — a supervisor's rerun resumes through the
+  existing checkpoint-restore path.
+
+Serving side (server/engine_server.py): :func:`validate_model_finite`
+is the finite-params sanity gate of the staged reload — a candidate
+model instance whose arrays carry NaN/Inf never reaches the swap.
+
+Like the rest of :mod:`predictionio_tpu.resilience`, importing this
+module never imports jax (all array touches are lazy), so the jax-free
+event server can share the package.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import signal
+import threading
+import time
+import _thread
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+from predictionio_tpu.obs import get_registry, publish_event
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PREEMPTED_EXIT_CODE",
+    "StepTimedOut",
+    "TrainDiverged",
+    "TrainPreempted",
+    "RollbackRequested",
+    "ModelValidationError",
+    "StepWatchdog",
+    "DivergenceGuard",
+    "install_preemption_handler",
+    "request_preemption",
+    "preemption_requested",
+    "clear_preemption",
+    "all_finite",
+    "iter_model_arrays",
+    "validate_model_finite",
+]
+
+# Exit code `pio train` uses after a SIGTERM-triggered final checkpoint.
+# 143 = 128 + SIGTERM: the code a supervisor already expects from a
+# terminated process, except here it certifies a CLEAN preemption — the
+# final checkpoint is durable and a rerun resumes from it.
+PREEMPTED_EXIT_CODE = 143
+
+
+class StepTimedOut(RuntimeError):
+    """A device step exceeded ``PIO_STEP_TIMEOUT_S`` (watchdog abort)."""
+
+
+class TrainDiverged(RuntimeError):
+    """Training produced non-finite state and exhausted its rollbacks."""
+
+    def __init__(self, fn: str, step: int, what: str, rollbacks: int):
+        super().__init__(
+            f"{fn} training diverged at step {step} ({what}) after "
+            f"{rollbacks} rollback(s) to the last-good checkpoint; the "
+            "non-finite model was NOT persisted.  Lower the learning "
+            "rate or inspect the data for this window.")
+        self.fn = fn
+        self.step = step
+        self.rollbacks = rollbacks
+
+
+class TrainPreempted(RuntimeError):
+    """SIGTERM during training: final checkpoint written, run handed back.
+
+    ``checkpointed`` says whether a resume point exists (False when the
+    run had no checkpoint directory — the rerun then starts fresh)."""
+
+    def __init__(self, fn: str, step: int, checkpointed: bool):
+        how = ("final checkpoint written — a rerun resumes from it"
+               if checkpointed else
+               "no checkpoint dir — a rerun restarts from scratch")
+        super().__init__(
+            f"{fn} training preempted at step {step} ({how}).")
+        self.fn = fn
+        self.step = step
+        self.checkpointed = checkpointed
+
+
+class RollbackRequested(Exception):
+    """Internal control flow: re-enter the training loop from the last
+    checkpoint.  Never escapes a ``train()`` entry point."""
+
+    def __init__(self, step: int, what: str):
+        super().__init__(f"rollback from step {step}: {what}")
+        self.step = step
+        self.what = what
+
+
+class ModelValidationError(RuntimeError):
+    """A candidate model failed reload validation (finite check/canary)."""
+
+
+# -- preemption flag ---------------------------------------------------------
+
+_preempted = threading.Event()
+
+
+def request_preemption() -> None:
+    """Ask the running training loops to checkpoint and hand back."""
+    _preempted.set()
+
+
+def preemption_requested() -> bool:
+    return _preempted.is_set()
+
+
+def clear_preemption() -> None:
+    _preempted.clear()
+
+
+def install_preemption_handler() -> bool:
+    """SIGTERM → preemption flag (idempotent; False off the main thread).
+
+    The loops notice at the next step boundary, force a final checkpoint,
+    and raise :class:`TrainPreempted`; ``pio train`` exits with
+    :data:`PREEMPTED_EXIT_CODE`.  SIGINT keeps its KeyboardInterrupt
+    semantics (interactive Ctrl-C should stop NOW, not checkpoint)."""
+
+    def _handler(signum, frame):
+        logger.warning("SIGTERM: preemption requested — training will "
+                       "checkpoint at the next step boundary and exit %d",
+                       PREEMPTED_EXIT_CODE)
+        request_preemption()
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        return False
+
+
+# -- finiteness --------------------------------------------------------------
+
+def _leaf_finite(x: Any) -> bool:
+    """True when ``x`` is not a non-finite float array/scalar."""
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        if isinstance(x, float):
+            return math.isfinite(x)
+        return True
+    import numpy as np
+
+    if not np.issubdtype(np.dtype(dtype), np.inexact):
+        return True
+    if x.__class__.__module__.startswith("jax") or hasattr(x, "addressable_shards"):
+        # Reduce on device; only the scalar crosses to host.
+        import jax.numpy as jnp
+
+        return bool(jnp.isfinite(x).all())
+    return bool(np.isfinite(np.asarray(x)).all())
+
+
+def all_finite(tree: Any) -> bool:
+    """Every inexact leaf of a pytree is finite (lazy jax import)."""
+    import jax
+
+    return all(_leaf_finite(leaf) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+_ATOMIC = (str, bytes, bool, int, float, complex, type(None))
+
+
+def iter_model_arrays(obj: Any, max_depth: int = 6,
+                      _path: str = "model") -> Iterator[Tuple[str, Any]]:
+    """Yield ``(path, array)`` for every array reachable inside an
+    arbitrary model object (dataclasses, dicts, sequences, plain
+    ``__dict__`` objects), bounded by ``max_depth``.
+
+    Loaded engine models are wrapper objects, not pytrees — this is the
+    walk the staged-reload finite check uses to find their tensors."""
+    if max_depth < 0 or isinstance(obj, _ATOMIC):
+        return
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        yield _path, obj
+        return
+    # Atomic children are filtered BEFORE any path-string formatting: a
+    # model holding a large plain dict (str → score) must cost one
+    # isinstance per entry here, not a formatted path per entry.
+    if isinstance(obj, dict):
+        items = ((f"{_path}[{k!r}]", v) for k, v in obj.items()
+                 if not isinstance(v, _ATOMIC))
+    elif isinstance(obj, (list, tuple)):
+        items = ((f"{_path}[{i}]", v) for i, v in enumerate(obj)
+                 if not isinstance(v, _ATOMIC))
+    elif hasattr(obj, "__dict__"):
+        items = ((f"{_path}.{k}", v) for k, v in vars(obj).items()
+                 if not k.startswith("_") and not isinstance(v, _ATOMIC))
+    else:
+        return
+    for p, v in items:
+        yield from iter_model_arrays(v, max_depth - 1, p)
+
+
+def validate_model_finite(model: Any, name: str = "model") -> None:
+    """Raise :class:`ModelValidationError` naming the first non-finite
+    array found anywhere inside ``model`` (the reload sanity gate)."""
+    for path, arr in iter_model_arrays(model, _path=name):
+        if not _leaf_finite(arr):
+            raise ModelValidationError(
+                f"candidate model has non-finite values at {path} "
+                f"(shape {getattr(arr, 'shape', '?')}) — refusing to "
+                "serve it")
+
+
+# -- divergence guard --------------------------------------------------------
+
+class DivergenceGuard:
+    """Bounded-rollback divergence policy for one training run.
+
+    ``check(loss, step)`` / ``check_params(tree, step)`` return silently
+    while the values are finite.  On the first non-finite observation
+    they raise :class:`RollbackRequested` (the loop re-enters from the
+    last-good checkpoint); after ``max_rollbacks`` observations they
+    raise :class:`TrainDiverged`.  Every observation increments
+    ``pio_train_divergence_total{fn}`` and lands a ``train.diverged``
+    event in the trace ring."""
+
+    def __init__(self, fn: str, max_rollbacks: Optional[int] = None,
+                 registry=None):
+        if max_rollbacks is None:
+            try:
+                max_rollbacks = int(
+                    os.environ.get("PIO_DIVERGENCE_RETRIES", "2"))
+            except ValueError:
+                max_rollbacks = 2
+        self.fn = fn
+        self.max_rollbacks = max(0, int(max_rollbacks))
+        self.rollbacks = 0
+        self._registry = registry
+
+    def _counter(self):
+        return (self._registry or get_registry()).counter(
+            "pio_train_divergence_total",
+            "Non-finite loss/params observations per training loop.",
+            ("fn",))
+
+    def check(self, loss: Any, step: int) -> None:
+        """Host-side finiteness check of a READY loss scalar.  The loops
+        call this right after the pipeline probe's sync — the value is
+        already materialized, so the check costs one float()."""
+        try:
+            value = float(loss)
+        except TypeError:
+            return
+        if math.isfinite(value):
+            return
+        self.diverged(step, f"loss={value}")
+
+    def check_params(self, tree: Any, step: int) -> None:
+        if all_finite(tree):
+            return
+        self.diverged(step, "non-finite params")
+
+    def diverged(self, step: int, what: str) -> None:
+        """Record one observed divergence: raises
+        :class:`RollbackRequested` while rollbacks remain, then
+        :class:`TrainDiverged`."""
+        self._counter().inc(fn=self.fn)
+        will_rollback = self.rollbacks < self.max_rollbacks
+        publish_event("train.diverged", fn=self.fn, step=int(step),
+                      what=what, rollback=will_rollback)
+        if not will_rollback:
+            raise TrainDiverged(self.fn, step, what, self.rollbacks)
+        self.rollbacks += 1
+        logger.warning(
+            "%s: non-finite training state at step %d (%s) — rolling "
+            "back to the last-good checkpoint (rollback %d/%d)",
+            self.fn, step, what, self.rollbacks, self.max_rollbacks)
+        raise RollbackRequested(step, what)
+
+
+# -- step watchdog -----------------------------------------------------------
+
+def _default_abort() -> None:
+    """Raise KeyboardInterrupt in the main thread — unwinds ``pio train``
+    through its normal teardown.  A runtime hung inside a C call may not
+    honor it; the supervisor's process-level timeout is the backstop."""
+    _thread.interrupt_main()
+
+
+class StepWatchdog:
+    """Deadline monitor over individual device steps.
+
+    The training loop arms the watchdog before blocking on a step and
+    disarms after the step dispatches; a step still armed past
+    ``timeout_s`` (env ``PIO_STEP_TIMEOUT_S``; unset/0 disables) fires
+    exactly once: ``pio_watchdog_fired_total{fn}`` increments, a
+    ``watchdog.fired`` event carrying the last step-timeline entry lands
+    in the trace ring, ``checkpoint_fn`` runs (the loops pass the
+    checkpointer's flush, making the resume point durable), then
+    ``abort_fn`` aborts the run instead of letting it hang forever.
+
+    ``clock`` / ``abort_fn`` / ``checkpoint_fn`` are injectable and
+    :meth:`poll` is public, so the fault matrix drives expiry on a fake
+    clock with no wall sleeps.  The background poller thread starts
+    lazily on the first :meth:`arm` (never when disabled, or when
+    ``poll_interval_s <= 0``)."""
+
+    def __init__(self, fn: str, timeout_s: Optional[float] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 checkpoint_fn: Optional[Callable[[], None]] = None,
+                 abort_fn: Callable[[], None] = _default_abort,
+                 poll_interval_s: Optional[float] = None,
+                 registry=None, timeline=None):
+        if timeout_s is None:
+            try:
+                timeout_s = float(os.environ.get("PIO_STEP_TIMEOUT_S", "0"))
+            except ValueError:
+                timeout_s = 0.0
+        self.fn = fn
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        self._checkpoint_fn = checkpoint_fn
+        self._abort_fn = abort_fn
+        if poll_interval_s is None:
+            poll_interval_s = min(1.0, self.timeout_s / 4) \
+                if self.timeout_s > 0 else 0.0
+        self.poll_interval_s = float(poll_interval_s)
+        self._registry = registry
+        self._timeline = timeline
+        self._lock = threading.Lock()
+        self._armed: Optional[Tuple[int, float]] = None  # (step, deadline)
+        self.fired_steps: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def _counter(self):
+        return (self._registry or get_registry()).counter(
+            "pio_watchdog_fired_total",
+            "Device steps that exceeded PIO_STEP_TIMEOUT_S.", ("fn",))
+
+    def arm(self, step: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._armed = (int(step), self._clock() + self.timeout_s)
+        self._ensure_thread()
+
+    def disarm(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._armed = None
+
+    def poll(self) -> bool:
+        """Check the armed deadline; fire (once) when expired."""
+        with self._lock:
+            if self._armed is None:
+                return False
+            step, deadline = self._armed
+            if self._clock() < deadline:
+                return False
+            self._armed = None  # consume: fire exactly once per arm
+        self._fire(step)
+        return True
+
+    def _fire(self, step: int) -> None:
+        self.fired_steps.append(step)
+        self._counter().inc(fn=self.fn)
+        from predictionio_tpu.obs.runtime import get_timeline
+
+        last = (self._timeline or get_timeline()).recent(1, model=self.fn)
+        # JSON-encoded: trace attrs keep only primitives, and the last
+        # timeline entry is the evidence ("the step before the hang
+        # looked like THIS") an operator reads out of /traces.json.
+        publish_event("watchdog.fired", fn=self.fn, step=step,
+                      timeoutS=self.timeout_s,
+                      lastStep=json.dumps(last[0]) if last else None)
+        logger.critical(
+            "%s: device step %d exceeded PIO_STEP_TIMEOUT_S=%.1fs — "
+            "flushing checkpoints and aborting the run (last timeline "
+            "entry: %s)", self.fn, step, self.timeout_s,
+            last[0] if last else "none")
+        if self._checkpoint_fn is not None:
+            try:
+                self._checkpoint_fn()
+            except Exception:
+                logger.exception("watchdog checkpoint flush failed")
+        self._abort_fn()
+
+    # -- background poller ---------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self.poll_interval_s <= 0:
+            return
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"pio-watchdog-{self.fn}",
+                daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll()
+            except Exception:
+                logger.exception("watchdog poll failed")
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+        self.disarm()

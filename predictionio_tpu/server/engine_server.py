@@ -17,6 +17,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -32,12 +33,18 @@ from predictionio_tpu.data.storage import (
 from predictionio_tpu.obs import (
     get_recorder,
     get_registry,
+    publish_event,
     span,
     start_runtime_introspection,
 )
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.resilience.faults import fault_point
+from predictionio_tpu.resilience.policy import CircuitBreaker, CircuitOpenError
+from predictionio_tpu.resilience.supervision import (
+    ModelValidationError,
+    validate_model_finite,
+)
 from predictionio_tpu.server.http import (
     BaseHandler,
     ThreadingHTTPServer,
@@ -118,12 +125,36 @@ class _QueryMetrics:
                               "p99": self.latency.quantile(0.99)}}
 
 
+class _Generation:
+    """One immutable loaded-model generation (instance + built serving
+    stack).  The server swaps whole generations under the lock and keeps
+    the previous one for instant ``POST /admin/rollback``."""
+
+    __slots__ = ("instance", "models", "algorithms", "serving", "loaded_at",
+                 "number")
+
+    def __init__(self, instance, models, algorithms, serving, loaded_at,
+                 number):
+        self.instance = instance
+        self.models = models
+        self.algorithms = algorithms
+        self.serving = serving
+        self.loaded_at = loaded_at
+        self.number = number
+
+
 class EngineServer:
     """Loads a trained engine instance and serves queries over HTTP.
 
     Reference roles: MasterActor (lifecycle/reload supervision) and
-    ServerActor (request handling) collapse into this class — Python
-    threading + a swap-under-lock reload replaces actor supervision.
+    ServerActor (request handling) collapse into this class.  The reload
+    path is STAGED (the rebuild's answer to actor supervision — ISSUE 4):
+    breaker-guarded storage reads, candidate built off to the side,
+    validated (finite params + optional ``PIO_CANARY_QUERIES`` golden
+    queries), then atomically swapped under the lock with the previous
+    generation retained for ``POST /admin/rollback``.  A failed reload
+    keeps serving the last-good model — ``pio_model_reload_total{result}``
+    and ``pio_model_generation`` make the outcome observable.
     """
 
     def __init__(
@@ -139,6 +170,7 @@ class EngineServer:
         instance_id: Optional[str] = None,
         mesh_spec: Optional[str] = None,
         plugins=None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         from predictionio_tpu.server.plugins import PluginManager
 
@@ -164,6 +196,7 @@ class EngineServer:
         self._models: List[Any] = []
         self._serving = None
         self._loaded_at: Optional[_dt.datetime] = None
+        self._init_lifecycle_state(breaker)
         self.reload()
         # Server plugin seam (reference: EngineServerPlugin, SURVEY §5.1).
         # Started LAST — after reload() — so plugins see a fully
@@ -174,9 +207,39 @@ class EngineServer:
 
     # -- model lifecycle ----------------------------------------------------
 
-    def reload(self) -> str:
-        """(Re)load the latest COMPLETED instance (reference: /reload after
-        retrain — MasterActor swaps ServerActor)."""
+    def _init_lifecycle_state(self,
+                              breaker: Optional[CircuitBreaker] = None
+                              ) -> None:
+        """Staged-reload state: lock, generations, breaker, instruments.
+        Factored out of ``__init__`` so test skeletons built with
+        ``__new__`` (tests/test_resilience.py) stay in lock-step."""
+        self._reload_lock = threading.Lock()  # serialize staged reloads
+        self._generation = 0
+        self._previous: Optional[_Generation] = None
+        self._last_reload: Dict[str, Any] = {}
+        # Breaker around reload()'s storage reads (ROADMAP resilience
+        # follow-on (a)): a dead model store must shed fast with
+        # Retry-After, not hang every /reload until TCP gives up.
+        self._breaker = breaker or CircuitBreaker(
+            "modeldata",
+            failure_threshold=int(os.environ.get(
+                "PIO_BREAKER_THRESHOLD", "5")),
+            recovery_time_s=float(os.environ.get(
+                "PIO_BREAKER_RECOVERY_S", "10")),
+            failure_types=(StorageUnavailable, ConnectionError))
+        self.retry_after_s = int(os.environ.get("PIO_RETRY_AFTER_S", "5"))
+        reg = self.stats.registry
+        self._reload_total = reg.counter(
+            "pio_model_reload_total",
+            "Staged model reloads by outcome.", ("result",))
+        self._gen_gauge = reg.gauge(
+            "pio_model_generation",
+            "Monotonic generation of the model currently serving "
+            "(bumped by every successful reload or rollback).")
+
+    def _load_candidate(self):
+        """Storage-read phase of the staged reload (runs under the
+        breaker): resolve the target instance and load its models."""
         instances = self.storage.get_engine_instances()
         if self.requested_instance_id:
             instance = instances.get(self.requested_instance_id)
@@ -193,17 +256,121 @@ class EngineServer:
                     f"{self.engine_id!r} variant {self.variant.variant_id!r} — "
                     "run `pio train` first.")
         models = load_models(self.engine, instance, self.ctx)
-        engine_params = instance_engine_params(self.engine, instance)
-        algorithms = self.engine.make_algorithms(engine_params)
-        serving = self.engine.make_serving(engine_params)
-        with self._swap_lock:
-            self._instance = instance
-            self._models = models
-            self._algorithms = algorithms
-            self._serving = serving
-            self._loaded_at = _dt.datetime.now(_dt.timezone.utc)
-        logger.info("Engine server loaded instance %s", instance.id)
-        return instance.id
+        return instance, models
+
+    @staticmethod
+    def _canary_queries() -> List[Any]:
+        """Golden queries from ``PIO_CANARY_QUERIES``: inline JSON array,
+        or a path to a JSON-array / NDJSON file.  Empty/unset disables
+        the canary stage."""
+        raw = os.environ.get("PIO_CANARY_QUERIES", "").strip()
+        if not raw:
+            return []
+        if raw.startswith("["):
+            return json.loads(raw)
+        with open(raw, encoding="utf-8") as f:
+            text = f.read().strip()
+        if text.startswith("["):
+            return json.loads(text)
+        return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+    def _validate_candidate(self, instance, models, algorithms,
+                            serving) -> None:
+        """Validation stage: a candidate that cannot be trusted never
+        reaches the swap.  Finite-params sanity over every array the
+        models carry, then the optional golden-query canary — each
+        PIO_CANARY_QUERIES entry must predict without raising."""
+        for i, model in enumerate(models):
+            validate_model_finite(model, name=f"models[{i}]")
+        for qi, query_json in enumerate(self._canary_queries()):
+            try:
+                self._predict_with(algorithms, models, serving, query_json)
+            except Exception as e:
+                raise ModelValidationError(
+                    f"candidate instance {instance.id} failed canary "
+                    f"query #{qi} ({query_json!r}): "
+                    f"{type(e).__name__}: {e}") from e
+
+    def _record_reload(self, result: str, error: Optional[str] = None,
+                       **extra) -> None:
+        self._reload_total.inc(result=result)
+        self._last_reload = {
+            "result": result,
+            "at": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+            **({"error": error} if error else {}),
+        }
+        publish_event("model.reload", result=result,
+                      **({"error": error[:200]} if error else {}), **extra)
+
+    def reload(self) -> str:
+        """Staged reload of the latest COMPLETED instance (reference:
+        /reload after retrain — MasterActor swaps ServerActor).
+
+        read (breaker-guarded) → build → validate → swap; any failure
+        keeps the last-good generation serving and raises.  The previous
+        generation is retained for :meth:`rollback`."""
+        with self._reload_lock:
+            try:
+                instance, models = self._breaker.call(self._load_candidate)
+                engine_params = instance_engine_params(self.engine, instance)
+                algorithms = self.engine.make_algorithms(engine_params)
+                serving = self.engine.make_serving(engine_params)
+                self._validate_candidate(instance, models, algorithms,
+                                         serving)
+            except Exception as e:
+                self._record_reload("failed", error=str(e))
+                logger.error("model reload failed (%s); %s", e,
+                             "serving continues on the last-good model"
+                             if self._instance is not None else
+                             "no model is loaded yet")
+                raise
+            now = _dt.datetime.now(_dt.timezone.utc)
+            with self._swap_lock:
+                if self._instance is not None:
+                    self._previous = _Generation(
+                        self._instance, self._models, self._algorithms,
+                        self._serving, self._loaded_at, self._generation)
+                self._instance = instance
+                self._models = models
+                self._algorithms = algorithms
+                self._serving = serving
+                self._loaded_at = now
+                self._generation += 1
+                gen = self._generation
+            self._gen_gauge.set(gen)
+            self._record_reload("ok", instance=instance.id, generation=gen)
+            logger.info("Engine server loaded instance %s (generation %d)",
+                        instance.id, gen)
+            return instance.id
+
+    def rollback(self) -> str:
+        """Instant swap back to the retained previous generation
+        (``POST /admin/rollback``).  The generations exchange places, so
+        a second rollback returns; raises when none is retained."""
+        with self._reload_lock:
+            with self._swap_lock:
+                prev = self._previous
+                if prev is None:
+                    raise WorkflowError(
+                        "No previous model generation retained — nothing "
+                        "to roll back to.")
+                self._previous = _Generation(
+                    self._instance, self._models, self._algorithms,
+                    self._serving, self._loaded_at, self._generation)
+                self._instance = prev.instance
+                self._models = prev.models
+                self._algorithms = prev.algorithms
+                self._serving = prev.serving
+                self._loaded_at = prev.loaded_at
+                self._generation += 1
+                gen = self._generation
+                instance_id = prev.instance.id
+            self._gen_gauge.set(gen)
+            self._record_reload("rollback", instance=instance_id,
+                                generation=gen)
+            logger.warning("Engine server rolled back to instance %s "
+                           "(generation %d)", instance_id, gen)
+            return instance_id
 
     # -- query path ---------------------------------------------------------
 
@@ -223,16 +390,11 @@ class EngineServer:
             return _dc_to_json(result)
         return result
 
-    def query(self, query_json: Any) -> Any:
-        """One predict round-trip (reference §3.2 hot path).
-
-        Span-per-phase under an active trace: bind → supplement →
-        per-algorithm predict → serve.  Outside a trace each ``span`` is
-        two perf_counter calls — the hot path stays hot.
-        """
-        with self._swap_lock:
-            algorithms, models, serving = (
-                self._algorithms, self._models, self._serving)
+    def _predict_with(self, algorithms, models, serving,
+                      query_json: Any) -> Any:
+        """bind → supplement → per-algorithm predict → serve against an
+        EXPLICIT model set — the live generation (``query``) and the
+        reload canary both ride this path."""
         with span("predict.bind"):
             q = self._bind_query(query_json)
         with span("predict.supplement"):
@@ -243,6 +405,18 @@ class EngineServer:
                 predictions.append(a.predict(m, q))
         with span("predict.serve"):
             return self._result_to_json(serving.serve(q, predictions))
+
+    def query(self, query_json: Any) -> Any:
+        """One predict round-trip (reference §3.2 hot path).
+
+        Span-per-phase under an active trace: bind → supplement →
+        per-algorithm predict → serve.  Outside a trace each ``span`` is
+        two perf_counter calls — the hot path stays hot.
+        """
+        with self._swap_lock:
+            algorithms, models, serving = (
+                self._algorithms, self._models, self._serving)
+        return self._predict_with(algorithms, models, serving, query_json)
 
     def query_batch(self, query_jsons: List[Any]) -> List[Any]:
         """Batched predict for the native continuous-batching frontend:
@@ -274,12 +448,18 @@ class EngineServer:
                 with self._swap_lock:
                     inst = self._instance
                     loaded = self._loaded_at
+                    gen = self._generation
+                    prev = self._previous
                 return 200, {
                     "status": "alive",
                     "engineFactory": self.variant.engine_factory,
                     "variant": self.variant.variant_id,
                     "engineInstanceId": inst.id if inst else None,
                     "modelLoadedAt": loaded.isoformat() if loaded else None,
+                    "modelGeneration": gen,
+                    "lastReload": self._last_reload or None,
+                    "rollbackAvailable": prev is not None,
+                    "breaker": self._breaker.state,
                     "version": __version__,
                 }
             if path == "/ready" and method == "GET":
@@ -305,9 +485,25 @@ class EngineServer:
                 # chrome://tracing / Perfetto export.
                 return 200, timeline_payload(params)
             if path == "/reload" and method == "POST":
-                instance_id = self.reload()
+                try:
+                    instance_id = self.reload()
+                except ModelValidationError as e:
+                    # Candidate rejected by the validation stage: the
+                    # last-good model keeps serving — a client fault
+                    # (bad train), not an availability failure.
+                    return 409, {"message": str(e),
+                                 "status": "rejected"}
                 return 200, {"status": "reloaded",
-                             "engineInstanceId": instance_id}
+                             "engineInstanceId": instance_id,
+                             "generation": self._generation}
+            if path == "/admin/rollback" and method == "POST":
+                try:
+                    instance_id = self.rollback()
+                except WorkflowError as e:
+                    return 409, {"message": str(e)}
+                return 200, {"status": "rolled_back",
+                             "engineInstanceId": instance_id,
+                             "generation": self._generation}
             if path == "/queries.json" and method == "POST":
                 t0 = time.perf_counter()
                 try:
@@ -336,11 +532,11 @@ class EngineServer:
         except DeadlineExceeded as e:
             self.stats.shed.inc(server="engine")
             return 504, {"message": str(e)}
-        except (ConnectionError, StorageUnavailable) as e:
-            # Injected faults and dead backends (e.g. reload's storage
-            # reads, which surface as StorageUnavailable once the remote
-            # client exhausts retries) are availability failures: 503,
-            # not a 500 bug report.
+        except (ConnectionError, StorageUnavailable, CircuitOpenError) as e:
+            # Injected faults, dead backends, and the reload breaker
+            # shedding (CircuitOpenError) are availability failures: 503
+            # + Retry-After, not a 500 bug report.  The last-good model
+            # keeps serving throughout.
             return 503, {"message": f"Temporarily unavailable: {e}"}
         except Exception:
             logger.exception("engine server internal error")
@@ -359,6 +555,13 @@ class EngineServer:
                 return server_self.plugins.on_request(
                     f"{method} {path}", status, ms) \
                     if server_self.plugins else None
+
+            def pio_retry_after_s(self):
+                # Breaker-open reload shed carries the breaker's actual
+                # recovery hint; other degraded answers the env default.
+                open_in = server_self._breaker.retry_after_s()
+                return max(1, int(open_in)) if open_in > 0 \
+                    else server_self.retry_after_s
 
             def do_GET(self):  # noqa: N802
                 self.dispatch("GET")

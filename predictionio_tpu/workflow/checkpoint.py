@@ -167,10 +167,23 @@ class TrainCheckpointer:
         return True
 
     def save(self, step: int, state: Any) -> None:
+        """Off-cadence forced save (preemption/watchdog paths).  A step
+        already on disk is NOT re-saved: orbax refuses to overwrite an
+        existing step directory, and the state for that step is already
+        durable anyway."""
         if self._mgr is not None:
             import orbax.checkpoint as ocp
 
+            if step in set(self._mgr.all_steps()):
+                return
             self._mgr.save(step, args=ocp.args.StandardSave(state), force=True)
+
+    def flush(self) -> None:
+        """Block until every pending async save is durable on disk (the
+        watchdog calls this before aborting a hung run, so the resume
+        point survives the abort)."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
     def complete(self) -> None:
         """Mark the run finished: flush pending saves, then CLEAR them.

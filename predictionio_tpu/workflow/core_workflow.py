@@ -46,6 +46,7 @@ from predictionio_tpu.obs import (
     phase as obs_phase,
     trace as obs_trace,
 )
+from predictionio_tpu.resilience.supervision import TrainPreempted
 from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
@@ -132,7 +133,21 @@ def run_train(
             (instance.end_time - instance.start_time).total_seconds(),
         )
         return instance_id
-    except Exception:
+    except TrainPreempted as e:
+        # SIGTERM preemption (resilience/supervision.py): a final
+        # checkpoint was written, so the distinct status tells the
+        # dashboard/supervisor this run resumes, not failed.
+        instance.status = "PREEMPTED"
+        instance.end_time = _now()
+        instances.update(instance)
+        logger.warning("EngineInstance %s PREEMPTED at step %d "
+                       "(rerun resumes from the checkpoint)",
+                       instance_id, e.step)
+        raise
+    except BaseException:
+        # BaseException, not Exception: the step watchdog's abort raises
+        # KeyboardInterrupt (interrupt_main) — that run must land as
+        # FAILED, not sit in TRAINING forever as a phantom live train.
         instance.status = "FAILED"
         instance.end_time = _now()
         instances.update(instance)

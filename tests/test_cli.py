@@ -200,3 +200,99 @@ def test_cli_eval_end_to_end(tmp_path, pio_home, capsys):
     assert "bestScore" in res and len(res["candidates"]) == 2
     insts = storage.get_evaluation_instances().get_completed()
     assert len(insts) == 1
+
+
+# -- pio spill: manual journal ops (ISSUE 4 satellite) -----------------------
+
+class TestSpillCli:
+    def _journal_with_backlog(self, spill_dir, storage, app_id):
+        """Write a journal with 2 pending records + 1 dead letter, as a
+        crashed event server would leave behind."""
+        from predictionio_tpu.resilience.spill import SpillJournal
+
+        j = SpillJournal(spill_dir)
+        ev = {"event": "rate", "entityType": "user", "entityId": "u1",
+              "targetEntityType": "item", "targetEntityId": "i1",
+              "properties": {"rating": 4.0},
+              "eventTime": "2026-01-02T03:04:05.000Z"}
+        j.append([ev, ev], app_id, None, token="tok-a")
+        j.append([ev], app_id, None, token="tok-b")
+        # a dead-letter file left by a previous replay (written directly:
+        # dead_letter() on a live journal also advances the offset, which
+        # is not the state a crashed server leaves behind)
+        with open(j.dead_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "reason": "EventValidationError: missing event",
+                "token": "tok-dead", "appId": app_id, "channelId": None,
+                "events": [{"entityType": "user", "entityId": "broken"}],
+            }) + "\n")
+        j.close()
+
+    def test_inspect_reports_pending_and_dead(self, clean_storage, capsys,
+                                              tmp_path):
+        from predictionio_tpu.data.storage import App, get_storage
+
+        storage = get_storage()
+        app_id = storage.get_apps().insert(App(id=None, name="spillapp"))
+        storage.get_events().init(app_id)
+        d = tmp_path / "spill"
+        self._journal_with_backlog(d, storage, app_id)
+        code, out = run(capsys, "spill", "inspect", "--dir", str(d))
+        assert code == 0
+        assert "2 record(s) / 3 event(s)" in out
+        assert "dead-lettered: 1 record(s) / 1 event(s)" in out
+        assert "tok-a, tok-b" in out
+
+    def test_drain_replays_into_storage(self, clean_storage, capsys,
+                                        tmp_path):
+        from predictionio_tpu.data.storage import App, get_storage
+        from predictionio_tpu.resilience.spill import journal_summary
+
+        storage = get_storage()
+        app_id = storage.get_apps().insert(App(id=None, name="spillapp"))
+        storage.get_events().init(app_id)
+        d = tmp_path / "spill"
+        self._journal_with_backlog(d, storage, app_id)
+        code, out = run(capsys, "spill", "drain", "--dir", str(d))
+        assert code == 0 and "Replayed 3 event(s)" in out
+        stored = list(storage.get_events().find(app_id, None, limit=None))
+        assert len(stored) == 3
+        assert journal_summary(d)["pendingEvents"] == 0
+        # drain is idempotent: nothing left, still exit 0
+        code, out = run(capsys, "spill", "drain", "--dir", str(d))
+        assert code == 0 and "Replayed 0 event(s)" in out
+        assert len(list(storage.get_events().find(app_id, None,
+                                                  limit=None))) == 3
+
+    def test_requeue_dead_then_drain(self, clean_storage, capsys, tmp_path):
+        from predictionio_tpu.data.storage import App, get_storage
+        from predictionio_tpu.resilience.spill import journal_summary
+
+        storage = get_storage()
+        app_id = storage.get_apps().insert(App(id=None, name="spillapp"))
+        storage.get_events().init(app_id)
+        d = tmp_path / "spill"
+        self._journal_with_backlog(d, storage, app_id)
+        code, out = run(capsys, "spill", "requeue-dead", "--dir", str(d))
+        assert code == 0 and "Requeued 1" in out
+        s = journal_summary(d)
+        assert s["deadRecords"] == 0 and s["pendingEvents"] == 4
+        # the requeued record is invalid (missing "event") — a drain
+        # dead-letters it again instead of wedging behind it
+        code, out = run(capsys, "spill", "drain", "--dir", str(d))
+        assert code == 0
+        assert journal_summary(d)["deadRecords"] == 1
+
+    def test_drain_refuses_locked_journal(self, clean_storage, capsys,
+                                          tmp_path):
+        from predictionio_tpu.resilience.spill import SpillJournal
+
+        d = tmp_path / "spill"
+        live = SpillJournal(d)  # simulates the running event server
+        try:
+            with pytest.raises(SystemExit):
+                main(["spill", "drain", "--dir", str(d)])
+            err = capsys.readouterr().err
+            assert "locked by a running event server" in err
+        finally:
+            live.close()

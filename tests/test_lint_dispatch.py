@@ -1,0 +1,73 @@
+"""tools/lint_dispatch.py: every server frontend rides BaseHandler.dispatch.
+
+ISSUE 4 satellite — locks in PR 3's transport dedup: a new frontend that
+bypasses dispatch (losing deadlines/shed/tracing) fails tier-1.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_dispatch  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_dispatch.check(REPO) == []
+
+
+def test_detects_handler_bypassing_dispatch():
+    src = """
+from predictionio_tpu.server.http import BaseHandler
+
+class Sneaky(BaseHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.wfile.write(b"{}")
+
+    def do_POST(self):
+        self.dispatch("POST")
+"""
+    violations = lint_dispatch.check_source(src, "sneaky.py")
+    assert len(violations) == 3  # no dispatch + send_response + wfile.write
+    assert any("do_GET" in v and "dispatch" in v for v in violations)
+    assert any("send_response" in v for v in violations)
+    assert any("wfile.write" in v for v in violations)
+    assert not any("do_POST" in v for v in violations)
+
+
+def test_detects_raw_basehttprequesthandler_subclass():
+    src = """
+from http.server import BaseHTTPRequestHandler
+
+class Rogue(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+"""
+    violations = lint_dispatch.check_source(src, "rogue.py")
+    assert len(violations) == 1
+    assert "raw http.server handler" in violations[0]
+
+
+def test_nested_handler_classes_are_checked():
+    """The real frontends define their Handler inside _make_handler —
+    the walker must reach nested ClassDefs."""
+    src = """
+def _make_handler(server_self):
+    class Handler(BaseHandler):
+        def do_GET(self):
+            self.wfile.write(b"hi")
+    return Handler
+"""
+    violations = lint_dispatch.check_source(src, "nested.py")
+    assert any("Handler.do_GET" in v for v in violations)
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    assert lint_dispatch.main([str(REPO)]) == 0
+    server_dir = tmp_path / "predictionio_tpu" / "server"
+    server_dir.mkdir(parents=True)
+    (server_dir / "bad.py").write_text(
+        "class H(BaseHandler):\n    def do_GET(self):\n        pass\n")
+    assert lint_dispatch.main([str(tmp_path)]) == 1

@@ -246,6 +246,7 @@ def _bare_engine_server():
     srv._algorithms = []
     srv._models = []
     srv._loaded_at = None
+    srv._init_lifecycle_state()  # staged-reload state (ISSUE 4)
     srv.variant = SimpleNamespace(engine_factory="f", variant_id="v")
     srv.engine = SimpleNamespace(query_class=None)
     return srv

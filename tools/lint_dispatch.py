@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Static check: every server frontend handler rides BaseHandler.dispatch.
+
+PR 3 folded the deadline-scope / shed / tracing / Retry-After transport
+plumbing into ``server/http.py`` ``BaseHandler.dispatch`` and made all
+four frontends ride it.  That dedup only stays true if nothing regresses
+it: a NEW frontend whose ``do_GET`` writes the response directly (or
+subclasses ``BaseHTTPRequestHandler`` without ``BaseHandler``) silently
+loses deadlines, load shedding, request ids, and tracing.  This lint
+locks the invariant in (ISSUE 4 satellite; a tier-1 test runs it in CI):
+
+1. Every ``ClassDef`` in ``predictionio_tpu/server/*.py`` that subclasses
+   ``BaseHTTPRequestHandler`` (directly or by name) must instead derive
+   from ``BaseHandler``.
+2. Every ``do_<METHOD>`` method of a ``BaseHandler`` subclass must call
+   ``self.dispatch(...)``.
+3. No ``do_<METHOD>`` body may call ``self.send_response`` /
+   ``self.wfile.write`` directly — replying outside ``dispatch``/
+   ``respond`` bypasses the shared headers.
+
+Usage: ``python tools/lint_dispatch.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# Handler base classes considered "rides the shared stack".
+_GOOD_BASES = {"BaseHandler"}
+# Subclassing these directly is the violation rule 1 catches.
+_RAW_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _calls_self_dispatch(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "dispatch"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            return True
+    return False
+
+
+def _direct_write_calls(fn: ast.FunctionDef) -> List[str]:
+    bad = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        a = node.func.attr
+        v = node.func.value
+        if a == "send_response" and isinstance(v, ast.Name) \
+                and v.id == "self":
+            bad.append("self.send_response")
+        if a == "write" and isinstance(v, ast.Attribute) \
+                and v.attr == "wfile" and isinstance(v.value, ast.Name) \
+                and v.value.id == "self":
+            bad.append("self.wfile.write")
+    return bad
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Violations in one module's source (path:line prefixed strings)."""
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = _base_names(node)
+        if node.name in _GOOD_BASES:
+            continue  # BaseHandler itself is THE sanctioned raw subclass
+        if any(b in _RAW_BASES for b in bases):
+            violations.append(
+                f"{filename}:{node.lineno}: class {node.name} subclasses "
+                f"a raw http.server handler — derive from "
+                f"server.http.BaseHandler so deadlines/shed/tracing apply")
+            continue
+        if not any(b in _GOOD_BASES for b in bases):
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not item.name.startswith("do_"):
+                continue
+            if not _calls_self_dispatch(item):
+                violations.append(
+                    f"{filename}:{item.lineno}: {node.name}.{item.name} "
+                    f"does not call self.dispatch(...) — the request "
+                    f"bypasses deadline scope, shedding, and tracing")
+            for call in _direct_write_calls(item):
+                violations.append(
+                    f"{filename}:{item.lineno}: {node.name}.{item.name} "
+                    f"calls {call} directly — reply through dispatch/"
+                    f"respond instead")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    """Violations across every server frontend module under ``root``."""
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    server_dir = root / "predictionio_tpu" / "server"
+    violations: List[str] = []
+    for path in sorted(server_dir.glob("*.py")):
+        violations.extend(
+            check_source(path.read_text(encoding="utf-8"), str(path)))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} dispatch-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_dispatch: all server frontends ride BaseHandler.dispatch.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

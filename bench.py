@@ -507,13 +507,18 @@ def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
     ``window`` fused steps (async) and returns the carried state,
     ``barrier`` forces completion of the final state.
 
-    The loop runs under a ``PipelineProbe`` (one probe "step" = one
-    window), so the round artifact carries the per-model host_wait / h2d
-    / device_wait decomposition of the measured gap — the timeline block
+    The window stream rides the ISSUE-5 ``DevicePrefetcher`` exactly
+    like the production train loops: window assembly + H2D run on the
+    prep thread, double-buffered, so staging overlaps the dispatched
+    device windows instead of serializing between them.  The loop runs
+    under a ``PipelineProbe`` (one probe "step" = one window), so the
+    round artifact carries the per-model host_wait / h2d_overlap /
+    device_wait decomposition of the measured gap — the timeline block
     ``tools/attribute_gap.py`` attributes."""
     import itertools
     import tempfile
 
+    from predictionio_tpu.data.prefetch import DevicePrefetcher
     from predictionio_tpu.native.feeder import EventFeeder, write_cache
     from predictionio_tpu.obs import PipelineProbe
 
@@ -531,7 +536,8 @@ def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
             fd.close()
 
         fd2 = EventFeeder(cache, bs, seed=2)
-        probe = PipelineProbe(model or prefix.strip("_"))
+        name = model or prefix.strip("_")
+        probe = PipelineProbe(name)
         try:
             def windows():
                 while True:
@@ -546,19 +552,24 @@ def _feeder_pipeline(prefix, bs, cache_kwargs, next_batch, stack_window,
 
             state, done = None, 0
             t0 = time.perf_counter()
-            for batches in probe.iter_host(
-                    itertools.islice(windows(), n_windows)):
-                with probe.h2d():
-                    arrays = stack_window(batches)
-                probe.sync()  # wait on window N-1: its state carries in
-                # async dispatch: the device chews this window while the
-                # feeder assembles the next one
-                state = run_window(state, arrays, window)
-                probe.dispatched(state, examples=window * bs)
-                done += window * bs
-            probe.finish()
-            barrier(state)
-            dt = time.perf_counter() - t0
+            # stack_window already stages to device arrays, so the
+            # prefetcher's put is the identity: prep + H2D both ride the
+            # prep thread, overlapped under the dispatched windows.
+            with DevicePrefetcher(
+                    itertools.islice(windows(), n_windows), stack_window,
+                    put_fn=lambda arrays: arrays,
+                    count_fn=lambda batches: window * bs,
+                    model=name) as pf:
+                for batch in probe.iter_prefetched(pf):
+                    probe.sync()  # wait on window N-1: its state carries
+                    # async dispatch: the device chews this window while
+                    # the prep thread assembles + uploads the next one
+                    state = run_window(state, batch.args, window)
+                    probe.dispatched(state, examples=batch.examples)
+                    done += batch.examples
+                probe.finish()
+                barrier(state)
+                dt = time.perf_counter() - t0
         finally:
             fd2.close()
     pipe = round(done / dt, 1)

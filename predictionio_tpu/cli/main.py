@@ -394,6 +394,10 @@ def cmd_train(args) -> int:
     elif getattr(args, "checkpoint_every", 0) > 0:
         _die("--checkpoint-every requires --checkpoint-dir DIR (where to "
              "save); without it no checkpoints would be written.")
+    if getattr(args, "prefetch_depth", 0) > 0:
+        # Overlapped input pipeline (data/prefetch.py): the deep-model
+        # train loops read this when constructing their DevicePrefetcher.
+        os.environ["PIO_PREFETCH_DEPTH"] = str(args.prefetch_depth)
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -1039,6 +1043,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--mesh", default=None, metavar="SPEC",
                    help="device mesh, e.g. 'data=8,model=2' or 'auto' "
                         "(default: env PIO_MESH, else single device)")
+    t.add_argument("--prefetch-depth", dest="prefetch_depth", type=int,
+                   default=0, metavar="N",
+                   help="staged batches the input pipeline keeps ahead "
+                        "of the device (default: env PIO_PREFETCH_DEPTH, "
+                        "else 2; raise on fast-feeder/slow-step "
+                        "workloads, lower if HBM headroom warns)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="evaluate engine-params candidates")

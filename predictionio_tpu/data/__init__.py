@@ -5,6 +5,7 @@ Reference layer: data/src/main/scala/org/apache/predictionio/data/
 time — see SURVEY.md header).
 """
 
+from predictionio_tpu.data.prefetch import DevicePrefetcher, PrefetchedBatch
 from predictionio_tpu.data.event import (
     BiMap,
     DataMap,
@@ -18,6 +19,8 @@ from predictionio_tpu.data.event import (
 )
 
 __all__ = [
+    "DevicePrefetcher",
+    "PrefetchedBatch",
     "BiMap",
     "DataMap",
     "DataMapError",

@@ -1,0 +1,306 @@
+"""Overlapped input pipeline: background batch prep + device prefetch.
+
+BENCH_r05 measured realized training throughput far below what the feeder
+delivers (45.9% pipeline gap for two-tower, 87.0% for DLRM) and the PR-3
+attribution (`tools/attribute_gap.py`) pinned the serialized host work:
+every step paid tail-batch padding, dtype conversion, and the H2D
+transfer **between** device steps, on the main thread, after blocking on
+step N-1.  :class:`DevicePrefetcher` moves that whole stage off the step
+loop:
+
+- a background **prep thread** pulls raw batches from the host iterator
+  (``numpy_epochs`` / ``feeder_epochs``), runs the caller's ``prep_fn``
+  (pad + convert + transforms) and eagerly issues the device transfer
+  (``jax.device_put``, or a caller ``put_fn`` applying ``NamedSharding``
+  when a mesh is active) — so batch N+1's H2D rides **under** batch N's
+  device compute instead of serializing after it;
+- a **bounded queue** (depth ``PIO_PREFETCH_DEPTH``, default 2) gives
+  double-buffering semantics: the prep thread stays at most ``depth``
+  batches ahead and blocks when the device is the bottleneck, bounding
+  host+device memory held by staged batches;
+- **resume fast-forward** (``skip_steps``): batches a checkpoint restore
+  already covers are consumed from the source for determinism (the
+  per-epoch shuffles must advance identically) but skipped *before* any
+  prep/transfer work is spent on them;
+- **clean shutdown + exception propagation**: errors raised by the
+  source, ``prep_fn`` or the transfer surface in the consuming thread at
+  the next ``next()``; ``close()`` (or leaving the ``with`` block — also
+  on ``TrainPreempted`` / ``TrainDiverged`` / watchdog aborts) stops the
+  thread, closes the source generator on the prep thread (temp dirs and
+  native feeders release deterministically), and joins.
+
+``device_put``/clock are injectable so unit tests exercise ordering,
+backpressure and shutdown with fakes and no accelerator stack; importing
+this module never imports jax (the default ``put`` resolves lazily).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = ["DevicePrefetcher", "PrefetchedBatch", "prefetch_depth"]
+
+# Live prefetchers, swept at interpreter exit: a prep thread still inside
+# a device transfer or a native-feeder call while CPython tears down is a
+# crash (daemon threads are frozen mid-C-call; C++ static destructors
+# then run under them).  Normal lifecycles never reach this — the sweep
+# is the backstop for abandoned iterators.
+_live: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _close_live_prefetchers() -> None:
+    for pf in list(_live):
+        try:
+            pf.close()
+        except Exception:
+            pass
+
+DEFAULT_DEPTH = 2
+
+# Producer-side poll granularity for stop/backpressure checks.  Queue
+# put/get with a timeout wake immediately on space/data; the timeout only
+# bounds how stale a stop request can go unnoticed.
+_POLL_S = 0.05
+
+
+def prefetch_depth(default: int = DEFAULT_DEPTH) -> int:
+    """``PIO_PREFETCH_DEPTH`` (min 1): staged batches the prep thread may
+    run ahead.  2 = classic double buffering (one in flight on the
+    device, one staged)."""
+    try:
+        depth = int(os.environ.get("PIO_PREFETCH_DEPTH", str(default)))
+    except ValueError:
+        depth = default
+    return max(depth, 1)
+
+
+class PrefetchedBatch:
+    """One staged batch: device args + the overlap-window bookkeeping."""
+
+    __slots__ = ("step", "args", "examples", "h2d_ms", "staged_s")
+
+    def __init__(self, step: int, args: Any, examples: int,
+                 h2d_ms: float, staged_s: float):
+        self.step = step          # 1-based global batch number (post-skip)
+        self.args = args          # device arrays, ready to dispatch
+        self.examples = examples  # real (pre-padding) examples
+        self.h2d_ms = h2d_ms      # prep + transfer time on the prep thread
+        self.staged_s = staged_s  # wall clock when staging finished
+
+
+class _Done:
+    """End-of-stream sentinel (the producer's last queue item)."""
+
+    __slots__ = ()
+
+
+_DONE = _Done()
+
+
+class DevicePrefetcher:
+    """Background batch-prep + bounded device prefetch over a host iterator.
+
+    Integration shape (two_tower/dlrm ``_train_attempt``)::
+
+        with DevicePrefetcher(epochs(), prep_fn, put_fn=put,
+                              skip_steps=start_step, model="dlrm") as pf:
+            for batch in probe.iter_prefetched(pf):   # PrefetchedBatch
+                probe.sync()                          # wait on step N-1
+                state, loss = train_step(state, *batch.args, cfg)
+                probe.dispatched(state, examples=batch.examples)
+
+    ``prep_fn(raw_batch)`` runs on the prep thread and returns the padded,
+    dtype-converted host arrays; ``put_fn(arrays)`` issues the device
+    transfer (default: lazy ``jax.device_put``) — on an async backend the
+    transfer proceeds while the device executes the previous step, which
+    is the point.  ``count_fn(raw_batch)`` reports the real example count
+    before padding (default ``len(batch[0])``).
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        prep_fn: Callable[[Any], Any],
+        *,
+        put_fn: Optional[Callable[[Any], Any]] = None,
+        depth: Optional[int] = None,
+        skip_steps: int = 0,
+        count_fn: Optional[Callable[[Any], int]] = None,
+        clock: Callable[[], float] = time.perf_counter,
+        wall_clock: Callable[[], float] = time.time,
+        model: str = "",
+        registry=None,
+    ):
+        self.depth = prefetch_depth() if depth is None else max(int(depth), 1)
+        self._source = source
+        self._prep_fn = prep_fn
+        self._put_fn = put_fn if put_fn is not None else _default_put
+        self._count_fn = count_fn if count_fn is not None \
+            else (lambda batch: len(batch[0]))
+        self._skip = max(int(skip_steps), 0)
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        # Real batches in the queue (gauge source — qsize() would count
+        # the _DONE sentinel too).  Updated from both threads, so the
+        # read-modify-write rides a lock.
+        self._staged = 0
+        self._staged_lock = threading.Lock()
+        self._depth_gauge = None
+        if model:
+            from predictionio_tpu.obs.metrics import get_registry
+
+            self._depth_gauge = (registry or get_registry()).gauge(
+                "pio_prefetch_queue_depth",
+                "Staged batches waiting in the prefetch queue.",
+                ("model",))
+            self._model = model
+        self._thread = threading.Thread(
+            target=self._run, name=f"pio-prefetch-{model or 'batch'}",
+            daemon=True)
+        _live.add(self)
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------------
+
+    def _run(self) -> None:
+        it = iter(self._source)
+        try:
+            step = 0
+            while not self._stop.is_set():
+                try:
+                    raw = next(it)
+                except StopIteration:
+                    break
+                step += 1
+                if step <= self._skip:
+                    continue  # resume fast-forward: no prep, no transfer
+                t0 = self._clock()
+                examples = int(self._count_fn(raw))
+                staged = self._put_fn(self._prep_fn(raw))
+                h2d_ms = (self._clock() - t0) * 1e3
+                if not self._offer(PrefetchedBatch(
+                        step, staged, examples, h2d_ms, self._wall_clock())):
+                    return  # closed while waiting for queue space
+        except BaseException as e:  # noqa: BLE001 — must reach the consumer
+            self._exc = e
+        finally:
+            self._done.set()
+            self._offer(_DONE, brief=True)
+            close = getattr(it, "close", None)
+            if close is not None:
+                # Close the source generator ON the prep thread: its
+                # finally blocks (temp dirs, native feeders) belong to
+                # the thread that was executing it.
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    def _offer(self, item: Any, brief: bool = False) -> bool:
+        """Bounded put that stays responsive to close(); ``brief`` makes
+        one best-effort attempt (the terminal sentinel — the consumer
+        also watches ``_done``, so a full queue loses nothing)."""
+        while True:
+            try:
+                self._q.put(item, timeout=_POLL_S)
+            except queue.Full:
+                if brief or self._stop.is_set():
+                    return False
+                continue
+            if not isinstance(item, _Done):
+                with self._staged_lock:
+                    self._staged += 1
+                    staged = self._staged
+                if self._depth_gauge is not None:
+                    self._depth_gauge.set(staged, model=self._model)
+            return True
+
+    # -- consumer ------------------------------------------------------------
+
+    def __iter__(self) -> Iterator[PrefetchedBatch]:
+        return self
+
+    def __next__(self) -> PrefetchedBatch:
+        if self._closed:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+            except queue.Empty:
+                if not self._done.is_set():
+                    continue
+                # Producer exited.  ``_done`` is set only after every real
+                # batch was enqueued, so one non-blocking drain closes the
+                # timed-out-get vs late-put race.
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    item = _DONE
+            if isinstance(item, _Done):
+                self._finish()
+                raise StopIteration
+            with self._staged_lock:
+                self._staged -= 1
+                staged = self._staged
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(staged, model=self._model)
+            return item
+
+    def _finish(self) -> None:
+        """End of stream: join the producer and surface its error."""
+        self._thread.join(timeout=5.0)
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            self._closed = True
+            raise exc
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the prep thread and release staged batches (idempotent).
+
+        Safe mid-stream: a producer blocked on a full queue observes the
+        stop flag within one poll tick; staged device buffers are dropped
+        (the arrays are garbage-collected, nothing to flush).
+        """
+        if self._closed:
+            return
+        self._closed = True
+        _live.discard(self)
+        self._stop.set()
+        while True:  # unblock a producer waiting for queue space
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(0, model=self._model)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def _default_put(arrays: Any) -> Any:
+    """Eager transfer of a pytree of host arrays (lazy jax import so the
+    module — and tests injecting a fake — never need an accelerator)."""
+    import jax
+
+    return jax.device_put(arrays)

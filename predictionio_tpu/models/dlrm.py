@@ -224,7 +224,13 @@ class _StepKey:
         return isinstance(other, _StepKey) and self._key == other._key
 
 
-@functools.partial(jax.jit, static_argnames=("key",), donate_argnums=(0,))
+# Batch tensors donated alongside the carried state (see two_tower): the
+# prefetched pipeline stages fresh buffers per step, so donation bounds
+# steady-state device memory at (prefetch depth + 1) batches.  CPU warns
+# the donation was unusable — expected there (pyproject filters it for
+# the test suite; where donation is real the warning stays audible).
+@functools.partial(jax.jit, static_argnames=("key",),
+                   donate_argnums=(0, 1, 2, 3, 4))
 def _train_step_impl(state_tuple, dense, cat, labels, weights, key: _StepKey):
     params, opt_state, step = state_tuple
     loss, grads = jax.value_and_grad(_loss)(params, dense, cat, labels,
@@ -242,6 +248,10 @@ _tracked_train_step = get_compile_tracker().wrap(
 
 def train_step(state: DLRMState, dense, cat, labels, weights,
                cfg: DLRMConfig, mesh: Optional[Mesh] = None):
+    """One optimizer step.  ``state`` AND the batch tensors are donated:
+    on donation-capable backends (TPU/GPU) the inputs are consumed — pass
+    fresh device buffers per call (as the prefetched train loop does),
+    not arrays you reuse afterwards."""
     (p, o, s), loss = _tracked_train_step(
         (state.params, state.opt_state, state.step),
         dense, cat, labels, weights, _StepKey(cfg, mesh))
@@ -366,52 +376,67 @@ def _train_attempt(
         from predictionio_tpu.native.build import load_library
 
         use_feeder = load_library("feeder") is not None
-    # Pipeline decomposition (ISSUE/BENCH_r05): host_wait vs h2d vs
-    # device wait, via the one-step-lag probe (no lost overlap).
+    # Overlapped input pipeline (ISSUE 5 / data/prefetch.py): padding +
+    # dtype conversion + H2D run on a background prep thread so batch
+    # N+1's transfer rides under batch N's device step (see two_tower).
+    from predictionio_tpu.data.prefetch import DevicePrefetcher
     from predictionio_tpu.obs import PipelineProbe
 
+    n_fields = cat.shape[1]
+
+    def prep(batch):
+        # Prep-thread staging: identical layout/dtypes to the historical
+        # inline path (tests pin bitwise equivalence on CPU).
+        d, c, y = batch
+        pad = bs - len(y)
+        return (
+            np.asarray(np.concatenate(
+                [d, np.zeros((pad, cfg.n_dense), np.float32)]), np.float32),
+            np.concatenate([c, np.zeros((pad, n_fields), np.int32)]),
+            np.asarray(np.concatenate(
+                [y, np.zeros(pad, np.float32)]), np.float32),
+            np.concatenate([np.ones(len(y), np.float32),
+                            np.zeros(pad, np.float32)]),
+        )
+
+    put = None
+    if sh is not None:
+        def put(arrays):
+            return tuple(put_sharded(a, mesh, sh) for a in arrays)
+
     probe = PipelineProbe("dlrm")
-    global_step = 0
+    global_step = start_step
     loss = None
     try:
-        for d, c, y in probe.iter_host(
-                feeder_epochs() if use_feeder else numpy_epochs()):
-            global_step += 1
-            if global_step <= start_step:
-                continue  # resume fast-forward: batch already trained
-            n_real = len(y)
-            with probe.h2d():
-                pad = bs - len(y)
-                d = np.concatenate([d, np.zeros((pad, cfg.n_dense), np.float32)])
-                c = np.concatenate([c, np.zeros((pad, cat.shape[1]), np.int32)])
-                w = np.concatenate([np.ones(len(y), np.float32),
-                                    np.zeros(pad, np.float32)])
-                y = np.concatenate([y, np.zeros(pad, np.float32)])
-                args = [jnp.asarray(d, jnp.float32), jnp.asarray(c),
-                        jnp.asarray(y, jnp.float32), jnp.asarray(w)]
-                if sh is not None:
-                    args = [put_sharded(a, mesh, sh) for a in args]
-            watchdog.arm(global_step)
-            probe.sync()  # wait on step N-1 here: its state feeds step N
-            if loss is not None:
-                guard.check(loss, global_step - 1)
-            state, loss = train_step(state, *args, cfg, mesh)
-            probe.dispatched(state, examples=n_real)
-            saved = False
-            if ckpt.enabled and global_step % ckpt.save_every == 0:
-                # Fresh watchdog deadline: the forced loss check blocks
-                # on the device and a hang here must fire too.
+        with DevicePrefetcher(
+                feeder_epochs() if use_feeder else numpy_epochs(),
+                prep, put_fn=put, skip_steps=start_step,
+                model="dlrm") as pf:
+            for batch in probe.iter_prefetched(pf):
+                global_step = batch.step
                 watchdog.arm(global_step)
-                guard.check(loss, global_step)  # never checkpoint a NaN state
-                saved = ckpt.maybe_save(
-                    global_step, (state.params, state.opt_state, state.step))
-            watchdog.disarm()
-            if preemption_requested():
-                if ckpt.enabled and not saved:
-                    ckpt.save(global_step,
-                              (state.params, state.opt_state, state.step))
-                ckpt.flush()
-                raise TrainPreempted("dlrm", global_step, ckpt.enabled)
+                probe.sync()  # wait on step N-1: its state feeds step N
+                if loss is not None:
+                    guard.check(loss, global_step - 1)
+                state, loss = train_step(state, *batch.args, cfg, mesh)
+                probe.dispatched(state, examples=batch.examples)
+                saved = False
+                if ckpt.enabled and global_step % ckpt.save_every == 0:
+                    # Fresh watchdog deadline: the forced loss check
+                    # blocks on the device and a hang here must fire too.
+                    watchdog.arm(global_step)
+                    guard.check(loss, global_step)  # never save a NaN state
+                    saved = ckpt.maybe_save(
+                        global_step,
+                        (state.params, state.opt_state, state.step))
+                watchdog.disarm()
+                if preemption_requested():
+                    if ckpt.enabled and not saved:
+                        ckpt.save(global_step,
+                                  (state.params, state.opt_state,
+                                   state.step))
+                    ckpt.flush()
+                    raise TrainPreempted("dlrm", global_step, ckpt.enabled)
         probe.finish()
         if loss is not None:
             guard.check(loss, global_step)

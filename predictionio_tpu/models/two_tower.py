@@ -159,7 +159,18 @@ def _loss(params: Dict, user_ids, item_ids, weights, temperature: float):
     return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+# Batch tensors are donated along with the carried state: each step
+# consumes its staged batch exactly once (data/prefetch.py creates fresh
+# device buffers per step), so donation lets the allocator reclaim the
+# batch memory at dispatch instead of waiting for Python GC — with a
+# prefetch queue holding `depth` staged batches, that bounds steady-state
+# device memory at (depth + 1) batches instead of growing with GC lag.
+# Backends without donation support (CPU) warn the donation was unusable;
+# expected there (pyproject filters it for the CPU test suite; anywhere
+# donation is real the warning stays audible — it would mean the memory
+# bound above is not holding).
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnums=(0, 1, 2, 3))
 def _train_step_impl(state: Tuple, user_ids, item_ids, weights, cfg) -> Tuple:
     params, opt_state, step = state
     loss, grads = jax.value_and_grad(_loss)(params, user_ids, item_ids,
@@ -180,6 +191,10 @@ _tracked_train_step = get_compile_tracker().wrap(
 # dataclasses aren't pytrees; tuple in/out keeps jit donation simple.
 def train_step(state: TwoTowerState, user_ids, item_ids, weights,
                cfg: TwoTowerConfig) -> Tuple[TwoTowerState, jax.Array]:
+    """One optimizer step.  ``state`` AND the batch tensors are donated:
+    on donation-capable backends (TPU/GPU) the inputs are consumed — pass
+    fresh device buffers per call (as the prefetched train loop does),
+    not arrays you reuse afterwards."""
     hcfg = _HashableConfig(cfg)
     (p, o, s), loss = _tracked_train_step(
         (state.params, state.opt_state, state.step),
@@ -321,58 +336,73 @@ def _train_attempt(
         from predictionio_tpu.native.build import load_library
 
         use_feeder = load_library("feeder") is not None
-    # Pipeline decomposition (ISSUE/BENCH_r05): host_wait vs h2d vs
-    # device wait, via the one-step-lag probe (no lost overlap).
+    # Overlapped input pipeline (ISSUE 5 / data/prefetch.py): tail-batch
+    # padding + dtype conversion + the device transfer run on a
+    # background prep thread, double-buffered, so batch N+1's H2D rides
+    # under batch N's device step.  The probe attributes the staging to
+    # the overlap window; only the queue wait stays on the step loop.
+    from predictionio_tpu.data.prefetch import DevicePrefetcher
     from predictionio_tpu.obs import PipelineProbe
 
+    def prep(batch):
+        # Prep-thread staging: identical layout/dtypes to the historical
+        # inline path (tests pin bitwise equivalence on CPU).
+        u, i, w = batch
+        pad = bs - len(u)
+        return (
+            np.concatenate([np.asarray(u, np.int64),
+                            np.zeros(pad, np.int64)]).astype(np.int32),
+            np.concatenate([np.asarray(i, np.int64),
+                            np.zeros(pad, np.int64)]).astype(np.int32),
+            np.concatenate([np.asarray(w, np.float32),
+                            np.zeros(pad, np.float32)]),
+        )
+
+    put = None
+    if batch_sharding is not None:
+        def put(arrays):
+            return tuple(put_sharded(a, mesh, batch_sharding)
+                         for a in arrays)
+
     probe = PipelineProbe("two_tower")
-    global_step = 0
+    global_step = start_step
     loss = None
     try:
-        for u, i, w in probe.iter_host(
-                feeder_epochs() if use_feeder else numpy_epochs()):
-            global_step += 1
-            if global_step <= start_step:
-                continue  # resume fast-forward: batch already trained
-            n_real = len(u)
-            with probe.h2d():
-                pad = bs - len(u)
-                u = np.concatenate([np.asarray(u, np.int64),
-                                    np.zeros(pad, np.int64)])
-                i = np.concatenate([np.asarray(i, np.int64),
-                                    np.zeros(pad, np.int64)])
-                w = np.concatenate([np.asarray(w, np.float32),
-                                    np.zeros(pad, np.float32)])
-                args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
-                if batch_sharding is not None:
-                    args = tuple(put_sharded(a, mesh, batch_sharding)
-                                 for a in args)
-            watchdog.arm(global_step)
-            probe.sync()  # wait on step N-1 here: its state feeds step N
-            if loss is not None:
-                # Step N-1's loss materialized with the sync above — the
-                # finiteness check costs one float().
-                guard.check(loss, global_step - 1)
-            state, loss = train_step(state, *args, cfg)
-            probe.dispatched(state, examples=n_real)
-            saved = False
-            if ckpt.enabled and global_step % ckpt.save_every == 0:
-                # Never checkpoint unvalidated state: force this step's
-                # loss (rare — only at the save cadence) so a rollback
-                # target is always finite.  Re-armed with a fresh
-                # deadline first: this float() blocks on the device, and
-                # a hang HERE must fire the watchdog too.
+        with DevicePrefetcher(
+                feeder_epochs() if use_feeder else numpy_epochs(),
+                prep, put_fn=put, skip_steps=start_step,
+                model="two_tower") as pf:
+            for batch in probe.iter_prefetched(pf):
+                global_step = batch.step
                 watchdog.arm(global_step)
-                guard.check(loss, global_step)
-                saved = ckpt.maybe_save(
-                    global_step, (state.params, state.opt_state, state.step))
-            watchdog.disarm()
-            if preemption_requested():
-                if ckpt.enabled and not saved:
-                    ckpt.save(global_step,
-                              (state.params, state.opt_state, state.step))
-                ckpt.flush()
-                raise TrainPreempted("two_tower", global_step, ckpt.enabled)
+                probe.sync()  # wait on step N-1: its state feeds step N
+                if loss is not None:
+                    # Step N-1's loss materialized with the sync above —
+                    # the finiteness check costs one float().
+                    guard.check(loss, global_step - 1)
+                state, loss = train_step(state, *batch.args, cfg)
+                probe.dispatched(state, examples=batch.examples)
+                saved = False
+                if ckpt.enabled and global_step % ckpt.save_every == 0:
+                    # Never checkpoint unvalidated state: force this
+                    # step's loss (rare — only at the save cadence) so a
+                    # rollback target is always finite.  Re-armed with a
+                    # fresh deadline first: this float() blocks on the
+                    # device, and a hang HERE must fire the watchdog too.
+                    watchdog.arm(global_step)
+                    guard.check(loss, global_step)
+                    saved = ckpt.maybe_save(
+                        global_step,
+                        (state.params, state.opt_state, state.step))
+                watchdog.disarm()
+                if preemption_requested():
+                    if ckpt.enabled and not saved:
+                        ckpt.save(global_step,
+                                  (state.params, state.opt_state,
+                                   state.step))
+                    ckpt.flush()
+                    raise TrainPreempted("two_tower", global_step,
+                                         ckpt.enabled)
         probe.finish()
         if loss is not None:
             guard.check(loss, global_step)

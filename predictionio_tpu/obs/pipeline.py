@@ -19,6 +19,16 @@ and timing the block attributes exactly the stall the pipeline already
 pays.  wall ≈ host_wait + h2d + device_wait + loop overhead, which is the
 decomposition ISSUE/BENCH needed.
 
+With the PR-5 prefetched input pipeline (``data/prefetch.py``), batch
+staging runs on a background thread and the transfer overlaps device
+compute, so billing it to the step loop would be wrong twice over:
+:meth:`PipelineProbe.iter_prefetched` times only the queue wait as
+``host_wait`` and attributes the staging cost to the **overlap window**
+(``pio_train_h2d_overlap_ms`` + the timeline's ``h2dOverlapMs``) instead
+of the sync point.  The serialized ``h2d`` component of such steps is 0
+by construction; ``tools/attribute_gap.py`` keeps reading the same
+host-lane wall decomposition either way.
+
 jax is imported lazily inside the sync so this module (like all of obs)
 stays importable without an accelerator stack.
 """
@@ -68,7 +78,7 @@ class _Timed:
 class PipelineProbe:
     """Per-model training-loop instrumentation over the shared registry.
 
-    Integration shape (two_tower.train / dlrm.train)::
+    Inline integration shape (pre-prefetch; bench harnesses, custom loops)::
 
         probe = PipelineProbe("dlrm")
         for batch in probe.iter_host(epochs()):      # host_wait
@@ -78,6 +88,14 @@ class PipelineProbe:
             state, loss = train_step(state, *args)
             probe.dispatched(state, examples=len(batch))
         probe.finish()                               # drain the last step
+
+    Prefetched shape (two_tower.train / dlrm.train via DevicePrefetcher)::
+
+        for batch in probe.iter_prefetched(pf):      # host_wait = queue wait
+            probe.sync()                             # device_wait (step N-1)
+            state, loss = train_step(state, *batch.args)
+            probe.dispatched(state, examples=batch.examples)
+        probe.finish()
     """
 
     def __init__(self, model: str,
@@ -95,6 +113,11 @@ class PipelineProbe:
         self._h2d = reg.histogram(
             "pio_train_h2d_ms",
             "Time staging a batch for the device (convert + transfer).",
+            labelnames)
+        self._h2d_overlap = reg.histogram(
+            "pio_train_h2d_overlap_ms",
+            "Background staging time overlapped under device compute "
+            "(prefetched pipeline; not part of the step-loop wall).",
             labelnames)
         self._device_wait = reg.histogram(
             "pio_train_device_wait_ms",
@@ -133,8 +156,10 @@ class PipelineProbe:
 
     # -- host side ---------------------------------------------------------
 
-    def iter_host(self, it: Iterable) -> Iterator:
-        """Wrap a batch iterator; each ``next()`` is timed as host_wait."""
+    def _iter_timed(self, it: Iterable, on_batch=None) -> Iterator:
+        """Shared skeleton: each ``next()`` is timed as host_wait; the
+        optional ``on_batch`` hook layers extra bookkeeping onto the
+        fresh ``_cur`` scratch before the batch is yielded."""
         it = iter(it)
         while True:
             t0 = time.perf_counter()
@@ -146,11 +171,30 @@ class PipelineProbe:
             self._host_wait.observe(ms, **self._labels)
             self._last["host_wait"].set(ms, **self._labels)
             self._cur = {"host_wait": ms, "start_s": time.time() - ms / 1e3}
+            if on_batch is not None:
+                on_batch(batch)
             yield batch
+
+    def iter_host(self, it: Iterable) -> Iterator:
+        """Wrap a batch iterator; each ``next()`` is timed as host_wait."""
+        return self._iter_timed(it)
 
     def h2d(self) -> _Timed:
         return _Timed(self._h2d, self._last["h2d"], self._labels,
                       self._cur, "h2d")
+
+    def iter_prefetched(self, prefetcher: Iterable) -> Iterator:
+        """Wrap a :class:`~predictionio_tpu.data.prefetch.DevicePrefetcher`
+        stream: the queue wait is ``host_wait`` (the only serialized host
+        cost left) and each batch's background staging time lands in the
+        overlap window (``h2d_overlap``), NOT in the step-loop wall."""
+        def on_batch(batch):
+            overlap_ms = float(getattr(batch, "h2d_ms", 0.0))
+            self._h2d_overlap.observe(overlap_ms, **self._labels)
+            self._cur["h2d_overlap"] = overlap_ms
+            self._cur["staged_s"] = getattr(batch, "staged_s", None)
+
+        return self._iter_timed(prefetcher, on_batch)
 
     # -- device side (one-step lag) ----------------------------------------
 
@@ -175,6 +219,9 @@ class PipelineProbe:
             start_s=meta.get("start_s"),
             host_wait_ms=meta.get("host_wait", 0.0),
             h2d_ms=meta.get("h2d", 0.0),
+            h2d_overlap_ms=meta.get("h2d_overlap", 0.0),
+            staged_s=meta.get("staged_s"),
+            dispatch_s=meta.get("dispatch_s"),
             device_wait_ms=(t1 - t0) * 1e3,
             device_step_ms=(t1 - self._pending_t0) * 1e3,
             examples=meta.get("examples", 0))
@@ -191,6 +238,10 @@ class PipelineProbe:
         self._step_no += 1
         meta = dict(self._cur)
         meta.setdefault("start_s", time.time())
+        # True dispatch wall time: the Chrome-trace export starts the
+        # device lane here instead of approximating from the step start,
+        # so h2d/compute overlap renders exactly.
+        meta["dispatch_s"] = time.time()
         meta["step"] = self._step_no
         meta["examples"] = examples
         self._pending_meta = meta

@@ -282,11 +282,33 @@ class DeviceMemorySampler:
         self._lock = threading.Lock()
         self._peaks: Dict[str, float] = {}
         self._peak_since: float = clock()
+        # HBM headroom guardrail (one warning per device per peak window):
+        # prefetch depth x donated buffers changes the training memory
+        # profile, so the per-run peak is checked against the device
+        # bytes limit every sample.
+        self._hbm_warned: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def _reg(self) -> MetricsRegistry:
         return self._registry or get_registry()
+
+    @staticmethod
+    def _warn_fraction() -> float:
+        """``PIO_HBM_WARN_FRACTION`` (default 0.9): warn when a train
+        run's peak ``bytes_in_use`` exceeds this fraction of the device
+        ``bytes_limit``.  <= 0 disables the check."""
+        try:
+            return float(os.environ.get("PIO_HBM_WARN_FRACTION", "0.9"))
+        except ValueError:
+            return 0.9
+
+    def _headroom_counter(self):
+        return self._reg().counter(
+            "pio_hbm_headroom_warn_total",
+            "Times a train-run memory peak crossed the HBM headroom "
+            "warning fraction (PIO_HBM_WARN_FRACTION of bytes_limit).",
+            ("device",))
 
     def _gauges(self):
         reg = self._reg()
@@ -302,6 +324,7 @@ class DeviceMemorySampler:
 
     def touch(self) -> None:
         self._gauges()
+        self._headroom_counter()
 
     @staticmethod
     def _label(device: Any) -> str:
@@ -337,6 +360,8 @@ class DeviceMemorySampler:
         if len(out) < len(devices):
             self._sample_live_arrays(gauge, out,
                                      skip=frozenset(out))
+        frac = self._warn_fraction()
+        warn: List[tuple] = []
         with self._lock:
             for label, row in out.items():
                 in_use = row.get("bytes_in_use", row.get("live_bytes"))
@@ -351,6 +376,24 @@ class DeviceMemorySampler:
                 peak = max(self._peaks.get(label, 0.0), in_use)
                 self._peaks[label] = peak
                 peak_gauge.set(peak, device=label)
+                # HBM headroom guardrail: the peak against the allocator
+                # limit, once per device per peak window (run_train's
+                # reset_peak re-arms it).
+                limit = row.get("bytes_limit")
+                if (frac > 0 and limit and peak > frac * limit
+                        and label not in self._hbm_warned):
+                    self._hbm_warned.add(label)
+                    warn.append((label, peak, limit))
+        for label, peak, limit in warn:
+            self._headroom_counter().inc(device=label)
+            logger.warning(
+                "HBM headroom: device %s train-run peak %.0f MiB is "
+                "%.1f%% of its %.0f MiB limit (warn fraction %.2f, "
+                "PIO_HBM_WARN_FRACTION) — reduce PIO_PREFETCH_DEPTH, "
+                "the batch size, or the model/table sharding footprint "
+                "before the allocator OOMs",
+                label, peak / 2**20, 100.0 * peak / limit,
+                limit / 2**20, frac)
         return out
 
     def _sample_live_arrays(self, gauge, out, skip=frozenset()) -> None:
@@ -392,6 +435,7 @@ class DeviceMemorySampler:
         """Start a fresh peak window (run_train calls this at run start)."""
         with self._lock:
             self._peaks.clear()
+            self._hbm_warned.clear()
             self._peak_since = self._clock()
 
     # -- background thread --------------------------------------------------
@@ -445,8 +489,11 @@ class StepTimeline:
     (records, default 2048).
     """
 
-    PHASES = ("host_wait", "h2d", "device_wait", "device_step")
-    # host-lane phases whose sum approximates the iteration's wall time
+    PHASES = ("host_wait", "h2d", "h2d_overlap", "device_wait",
+              "device_step")
+    # host-lane phases whose sum approximates the iteration's wall time.
+    # h2d_overlap is deliberately NOT here: prefetched staging runs under
+    # device compute (data/prefetch.py) and costs the step loop nothing.
     WALL_PHASES = ("host_wait", "h2d", "device_wait")
 
     def __init__(self, capacity: Optional[int] = None):
@@ -461,24 +508,36 @@ class StepTimeline:
         self._seq = 0
 
     def record(self, model: str, *, host_wait_ms: float = 0.0,
-               h2d_ms: float = 0.0, device_wait_ms: float = 0.0,
+               h2d_ms: float = 0.0, h2d_overlap_ms: float = 0.0,
+               device_wait_ms: float = 0.0,
                device_step_ms: float = 0.0, examples: int = 0,
                start_s: Optional[float] = None,
+               dispatch_s: Optional[float] = None,
+               staged_s: Optional[float] = None,
                step: Optional[int] = None) -> None:
         if start_s is None:
             start_s = time.time()
+        rec = {
+            "model": model,
+            "startS": round(float(start_s), 6),
+            "hostWaitMs": round(float(host_wait_ms), 4),
+            "h2dMs": round(float(h2d_ms), 4),
+            "h2dOverlapMs": round(float(h2d_overlap_ms), 4),
+            "deviceWaitMs": round(float(device_wait_ms), 4),
+            "deviceStepMs": round(float(device_step_ms), 4),
+            "examples": int(examples),
+        }
+        # True dispatch / staging-end wall clocks (when known): the
+        # Chrome export draws the device and prefetch lanes from these
+        # instead of approximating from the step start.
+        if dispatch_s is not None:
+            rec["dispatchS"] = round(float(dispatch_s), 6)
+        if staged_s is not None:
+            rec["stagedS"] = round(float(staged_s), 6)
         with self._lock:
             self._seq += 1
-            self._ring.append({
-                "model": model,
-                "step": int(step if step is not None else self._seq),
-                "startS": round(float(start_s), 6),
-                "hostWaitMs": round(float(host_wait_ms), 4),
-                "h2dMs": round(float(h2d_ms), 4),
-                "deviceWaitMs": round(float(device_wait_ms), 4),
-                "deviceStepMs": round(float(device_step_ms), 4),
-                "examples": int(examples),
-            })
+            rec["step"] = int(step if step is not None else self._seq)
+            self._ring.append(rec)
 
     def recent(self, n: int = 256,
                model: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -508,6 +567,7 @@ class StepTimeline:
         for r in items:
             totals["host_wait"] += r["hostWaitMs"]
             totals["h2d"] += r["h2dMs"]
+            totals["h2d_overlap"] += r.get("h2dOverlapMs", 0.0)
             totals["device_wait"] += r["deviceWaitMs"]
             totals["device_step"] += r["deviceStepMs"]
             examples += r["examples"]
@@ -526,19 +586,28 @@ class StepTimeline:
                         model: Optional[str] = None) -> Dict[str, Any]:
         """Chrome-trace-format export (``?format=chrome``).
 
-        Host-lane phases lay out sequentially from each step's start;
-        the device step rides a second lane from the same origin (its
-        true dispatch offset is not recorded — close enough to see
-        overlap structure).
+        Host-lane phases lay out sequentially from each step's start.
+        The device step rides a second lane from the recorded dispatch
+        timestamp (``dispatchS``) when present — the true h2d/compute
+        overlap — falling back to the step start for records written
+        before dispatch stamping.  Prefetched staging (``h2dOverlapMs``)
+        draws on a third lane, ending when the batch left the prep
+        thread (``stagedS``), so the overlap with the previous step's
+        device lane is visible rather than inferred.
         """
         records = self.recent(n, model=model)[::-1]  # chronological
         pids = {m: i + 1 for i, m in
                 enumerate(sorted({r["model"] for r in records}))}
+        has_prefetch = {r["model"] for r in records
+                        if r.get("h2dOverlapMs", 0) > 0}
         events: List[Dict[str, Any]] = []
         for m, pid in pids.items():
             events.append({"name": "process_name", "ph": "M", "pid": pid,
                            "tid": 0, "args": {"name": m}})
-            for tid, lane in ((0, "host"), (1, "device")):
+            lanes = [(0, "host"), (1, "device")]
+            if m in has_prefetch:
+                lanes.append((2, "prefetch"))
+            for tid, lane in lanes:
                 events.append({"name": "thread_name", "ph": "M", "pid": pid,
                                "tid": tid, "args": {"name": lane}})
         for r in records:
@@ -556,11 +625,22 @@ class StepTimeline:
                                "args": {"step": r["step"]}})
                 ts += dur
             if r["deviceStepMs"] > 0:
+                dev_ts = r.get("dispatchS", r["startS"]) * 1e6
                 events.append({"name": "device_step", "ph": "X", "pid": pid,
-                               "tid": 1, "ts": round(r["startS"] * 1e6, 3),
+                               "tid": 1, "ts": round(dev_ts, 3),
                                "dur": round(r["deviceStepMs"] * 1e3, 3),
                                "args": {"step": r["step"],
                                         "examples": r["examples"]}})
+            overlap = r.get("h2dOverlapMs", 0.0)
+            if overlap > 0:
+                dur = overlap * 1e3
+                end = r.get("stagedS")
+                if end is None:  # staging ended when the queue get returned
+                    end = r["startS"] + r["hostWaitMs"] / 1e3
+                events.append({"name": "h2d_overlap", "ph": "X", "pid": pid,
+                               "tid": 2, "ts": round(end * 1e6 - dur, 3),
+                               "dur": round(dur, 3),
+                               "args": {"step": r["step"]}})
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def clear(self) -> None:

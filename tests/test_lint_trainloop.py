@@ -1,0 +1,104 @@
+"""tools/lint_trainloop.py: deep-model train loops ride DevicePrefetcher.
+
+ISSUE 5 satellite — locks in the overlapped input pipeline: a model whose
+step loop stages batches inline (``jnp.asarray`` / ``jax.device_put`` /
+``put_sharded`` after the device sync) silently re-serializes H2D and
+reopens the feeder-vs-realized gap BENCH_r05 measured.  Tier-1 fails it.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_trainloop  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_trainloop.check(REPO) == []
+
+
+def test_detects_inline_staging_in_step_loop():
+    src = """
+import jax.numpy as jnp
+
+def _train_attempt(data, cfg):
+    with DevicePrefetcher(iter(data), lambda b: b) as pf:
+        for batch in pf:
+            args = jnp.asarray(batch)          # <- serialized H2D
+            state = step(state, args)
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 1
+    assert "jnp.asarray" in violations[0]
+    assert "step loop" in violations[0]
+
+
+def test_detects_missing_prefetcher():
+    src = """
+def _train_attempt(data, cfg):
+    for batch in iter(data):
+        state = step(state, batch)
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 1
+    assert "DevicePrefetcher" in violations[0]
+
+
+def test_staging_in_prep_closure_is_allowed():
+    src = """
+import numpy as np
+
+def _train_attempt(data, cfg):
+    def prep(b):
+        return np.concatenate([b, np.zeros(4, np.float32)])
+
+    def put(arrays):
+        return put_sharded(arrays, mesh, sh)   # outside any loop: fine
+
+    with DevicePrefetcher(iter(data), prep, put_fn=put) as pf:
+        for batch in pf:
+            state = step(state, *batch.args)
+"""
+    assert lint_trainloop.check_source(src, "model.py") == []
+
+
+def test_device_put_and_put_sharded_banned_in_loop():
+    src = """
+import jax
+
+def _train_attempt(data, cfg):
+    pf = DevicePrefetcher(iter(data), lambda b: b)
+    while True:
+        a = jax.device_put(next(pf))
+        b = put_sharded(a, mesh, sh)
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 2
+    assert any("jax.device_put" in v for v in violations)
+    assert any("put_sharded" in v for v in violations)
+
+
+def test_required_files_must_define_train_attempt():
+    violations = lint_trainloop.check_source(
+        "def train(x):\n    return x\n", "two_tower.py",
+        require_prefetcher=True)
+    assert len(violations) == 1
+    assert "_train_attempt" in violations[0]
+
+
+def test_host_numpy_in_loops_is_fine():
+    src = """
+import numpy as np
+
+def _train_attempt(data, cfg):
+    def epochs():
+        for epoch in range(3):
+            yield np.asarray(data[epoch], np.int64)   # host-side: fine
+
+    with DevicePrefetcher(epochs(), lambda b: b) as pf:
+        for batch in pf:
+            state = step(state, *batch.args)
+"""
+    assert lint_trainloop.check_source(src, "model.py") == []

@@ -443,10 +443,16 @@ class TestFeederTrainingIntegration:
         # model over the same data.  Compare in-batch loss on a fixed
         # probe batch.
         import jax.numpy as jnp
-        probe = (jnp.asarray(users[:64]), jnp.asarray(items[:64]),
-                 jnp.asarray(np.ones(64, np.float32)))
-        _, l_np = tt.train_step(s_np, *probe, cfg)
-        _, l_fd = tt.train_step(s_fd, *probe, cfg)
+
+        def probe():
+            # fresh device buffers per call: train_step donates its
+            # batch tensors (a reused jnp array would be deleted on
+            # donation-capable backends)
+            return (jnp.asarray(users[:64]), jnp.asarray(items[:64]),
+                    jnp.asarray(np.ones(64, np.float32)))
+
+        _, l_np = tt.train_step(s_np, *probe(), cfg)
+        _, l_fd = tt.train_step(s_fd, *probe(), cfg)
         assert abs(float(l_np) - float(l_fd)) < 0.5 * max(float(l_np), 0.1)
 
     def test_dlrm_feeder_vs_numpy_same_examples(self):

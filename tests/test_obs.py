@@ -656,3 +656,221 @@ class TestAttributeGap:
         f.write_text(json.dumps({"tpu_era": {}}))
         assert mod.main([str(f)]) == 1
         assert "no timeline data" in capsys.readouterr().out
+
+
+# -- overlapped input pipeline (ISSUE 5): probe + timeline + HBM guard ------
+
+class _FakePrefetched:
+    """Stands in for data.prefetch.PrefetchedBatch (duck-typed)."""
+
+    def __init__(self, step, args, examples, h2d_ms, staged_s):
+        self.step = step
+        self.args = args
+        self.examples = examples
+        self.h2d_ms = h2d_ms
+        self.staged_s = staged_s
+
+
+class TestPrefetchedProbe:
+    def test_overlap_attribution_and_dispatch_stamp(self):
+        reg = MetricsRegistry()
+        tl = StepTimeline(capacity=16)
+        probe = PipelineProbe("toy", registry=reg, timeline=tl)
+        batches = [_FakePrefetched(k, ("a",), 4, 12.5, 1000.0 + k)
+                   for k in (1, 2)]
+        for b in probe.iter_prefetched(iter(batches)):
+            probe.sync()
+            probe.dispatched({"s": b.step}, examples=b.examples)
+        probe.finish()
+        # staging lands in the overlap window, not the h2d wall component
+        assert reg.get("pio_train_h2d_overlap_ms").count(model="toy") == 2
+        assert reg.get("pio_train_h2d_ms").count(model="toy") == 0
+        recs = tl.recent(10, model="toy")
+        assert len(recs) == 2
+        for r in recs:
+            assert r["h2dOverlapMs"] == pytest.approx(12.5)
+            assert r["h2dMs"] == 0.0
+            assert r["dispatchS"] > 0          # true dispatch wall clock
+            assert r["stagedS"] >= 1000.0
+        s = tl.summary("toy")
+        assert s["phase_ms"]["h2d_overlap"] == pytest.approx(25.0)
+        # overlapped staging is excluded from the wall decomposition
+        assert "h2d_overlap" not in s["phase_share"]
+        parse_prometheus(reg.render())
+
+    def test_chrome_export_uses_dispatch_and_prefetch_lane(self):
+        tl = StepTimeline(capacity=8)
+        tl.record("m", host_wait_ms=1.0, h2d_overlap_ms=4.0,
+                  device_wait_ms=3.0, device_step_ms=9.0,
+                  start_s=100.0, dispatch_s=100.005, staged_s=99.999,
+                  examples=8)
+        doc = tl.to_chrome_trace()
+        xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        # the device lane starts at the recorded dispatch, not the
+        # step start
+        assert xs["device_step"]["ts"] == pytest.approx(100.005e6)
+        # overlapped staging draws on its own lane, ending at stagedS
+        pf = xs["h2d_overlap"]
+        assert pf["tid"] == 2
+        assert pf["ts"] + pf["dur"] == pytest.approx(99.999e6)
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["name"] == "thread_name"}
+        assert lanes == {"host", "device", "prefetch"}
+        json.dumps(doc)
+
+    def test_chrome_export_without_dispatch_falls_back(self):
+        tl = StepTimeline(capacity=8)
+        tl.record("m", host_wait_ms=1.0, device_step_ms=2.0, start_s=50.0)
+        doc = tl.to_chrome_trace()
+        xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert xs["device_step"]["ts"] == pytest.approx(50.0e6)
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["name"] == "thread_name"}
+        assert lanes == {"host", "device"}  # no prefetch lane if unused
+
+
+class TestHbmHeadroomWarning:
+    def setup_method(self):
+        reset_observability()
+
+    def _sampler(self, stats):
+        dev = _FakeDevice("tpu", 0, stats)
+        return DeviceMemorySampler(interval_s=0, devices_fn=lambda: [dev],
+                                   live_arrays_fn=lambda: [])
+
+    def test_warns_once_per_window_above_fraction(self, caplog):
+        stats = {"bytes_in_use": 950, "bytes_limit": 1000}
+        sampler = self._sampler(stats)
+        with caplog.at_level("WARNING"):
+            sampler.sample_once()
+            sampler.sample_once()  # second crossing must not re-warn
+        warns = [r for r in caplog.records if "HBM headroom" in r.message]
+        assert len(warns) == 1
+        assert "PIO_PREFETCH_DEPTH" in warns[0].message
+        c = get_registry().get("pio_hbm_headroom_warn_total")
+        assert c.value(device="tpu:0") == 1
+
+    def test_below_fraction_is_silent(self, caplog):
+        sampler = self._sampler({"bytes_in_use": 500, "bytes_limit": 1000})
+        with caplog.at_level("WARNING"):
+            sampler.sample_once()
+        assert not [r for r in caplog.records
+                    if "HBM headroom" in r.message]
+
+    def test_reset_peak_rearms_the_warning(self, caplog):
+        stats = {"bytes_in_use": 950, "bytes_limit": 1000}
+        sampler = self._sampler(stats)
+        with caplog.at_level("WARNING"):
+            sampler.sample_once()
+            sampler.reset_peak()  # new train run -> fresh guard
+            sampler.sample_once()
+        warns = [r for r in caplog.records if "HBM headroom" in r.message]
+        assert len(warns) == 2
+        assert get_registry().get(
+            "pio_hbm_headroom_warn_total").value(device="tpu:0") == 2
+
+    def test_fraction_env_override_and_disable(self, caplog, monkeypatch):
+        stats = {"bytes_in_use": 700, "bytes_limit": 1000}
+        monkeypatch.setenv("PIO_HBM_WARN_FRACTION", "0.5")
+        with caplog.at_level("WARNING"):
+            self._sampler(stats).sample_once()
+        assert [r for r in caplog.records if "HBM headroom" in r.message]
+        caplog.clear()
+        monkeypatch.setenv("PIO_HBM_WARN_FRACTION", "0")  # disabled
+        with caplog.at_level("WARNING"):
+            self._sampler(stats).sample_once()
+        assert not [r for r in caplog.records
+                    if "HBM headroom" in r.message]
+
+    def test_no_limit_no_warning(self, caplog):
+        # CPU live-array fallback has no bytes_limit: never warns
+        dev = _FakeDevice("cpu", 0, None)
+        sampler = DeviceMemorySampler(
+            interval_s=0, devices_fn=lambda: [dev],
+            live_arrays_fn=lambda: [_FakeArray(900, dev)])
+        with caplog.at_level("WARNING"):
+            sampler.sample_once()
+        assert not [r for r in caplog.records
+                    if "HBM headroom" in r.message]
+
+
+class TestAttributeGapCompare:
+    OLD = {
+        "tpu_era": {
+            "two_tower_pipeline_examples_per_sec": 500_000.0,
+            "two_tower_pipeline_gap_pct": 45.9,
+            "two_tower_feeder_examples_per_sec": 900_000.0,
+            "dlrm_pipeline_examples_per_sec": 120_000.0,
+            "dlrm_pipeline_gap_pct": 87.0,
+        },
+        "timeline": {
+            "two_tower": {"steps": 4,
+                          "phase_ms": {"host_wait": 10, "h2d": 70,
+                                       "device_wait": 20},
+                          "phase_share": {"host_wait": 0.1, "h2d": 0.7,
+                                          "device_wait": 0.2}},
+        },
+    }
+    NEW = {
+        "tpu_era": {
+            "two_tower_pipeline_examples_per_sec": 800_000.0,
+            "two_tower_pipeline_gap_pct": 12.0,
+            "two_tower_feeder_examples_per_sec": 900_000.0,
+            "dlrm_pipeline_examples_per_sec": 300_000.0,
+            "dlrm_pipeline_gap_pct": 40.0,
+        },
+        "timeline": {
+            "two_tower": {"steps": 4,
+                          "phase_ms": {"host_wait": 10, "h2d": 2,
+                                       "device_wait": 88,
+                                       "h2d_overlap": 60},
+                          "phase_share": {"host_wait": 0.1, "h2d": 0.02,
+                                          "device_wait": 0.88}},
+        },
+    }
+
+    def test_gap_delta_and_dominant_shift(self):
+        mod = _load_attribute_gap()
+        res = mod.compare(self.OLD, self.NEW)
+        tt = res["two_tower"]
+        assert tt["gap_delta_pct"] == pytest.approx(-33.9)
+        assert tt["realized_speedup"] == pytest.approx(1.6)
+        assert tt["dominant_shift"] == ("h2d", "device_wait")
+        # dlrm has gap numbers but no timeline in either round:
+        # compared on gaps alone, no dominant shift
+        assert res["dlrm"]["gap_delta_pct"] == pytest.approx(-47.0)
+        assert "dominant_shift" not in res["dlrm"]
+
+    def test_render_and_cli_exit_code(self, capsys, tmp_path):
+        mod = _load_attribute_gap()
+        old_f = tmp_path / "old.json"
+        new_f = tmp_path / "new.json"
+        old_f.write_text(json.dumps(self.OLD))
+        new_f.write_text(json.dumps(self.NEW))
+        assert mod.main(["--compare", str(old_f), str(new_f)]) == 0
+        out = capsys.readouterr().out
+        assert "45.9% -> 12.0% (-33.9 pts)" in out
+        assert "dominant component shifted: h2d" in out
+        assert "87.0% -> 40.0% (-47.0 pts)" in out
+
+    def test_driver_capture_with_truncated_tail_unwraps(self, tmp_path):
+        mod = _load_attribute_gap()
+        # a driver round whose tail was truncated mid-JSON (as committed
+        # BENCH_r05.json is): the tpu_era block is still rescued
+        inner = json.dumps(self.OLD)
+        # leading garbage + the object body minus its opening brace: no
+        # line parses whole, so the brace-scan rescue must kick in
+        wrapped = {"n": 5, "cmd": "python bench.py", "rc": 0,
+                   "tail": 'g": {"x": 1}}, ' + inner[1:]}
+        f = tmp_path / "r.json"
+        f.write_text(json.dumps(wrapped))
+        doc = mod.load_json(str(f))
+        assert doc["tpu_era"]["two_tower_pipeline_gap_pct"] == 45.9
+
+    def test_compare_nothing_usable_exits_nonzero(self, tmp_path):
+        mod = _load_attribute_gap()
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps({"tpu_era": {}}))
+        b.write_text(json.dumps({"tpu_era": {}}))
+        assert mod.main(["--compare", str(a), str(b)]) == 1

@@ -46,12 +46,16 @@ def test_loss_decreases():
     cfg = TwoTowerConfig(n_users=32, n_items=16, embed_dim=8, hidden_dims=(16,),
                          out_dim=8, batch_size=64, epochs=1, seed=2)
     state = init_state(cfg)
-    u = jnp.asarray(users[:64])
-    i = jnp.asarray(items[:64])
-    w = jnp.ones(64, jnp.float32)
+    u = users[:64]
+    i = items[:64]
+    w = np.ones(64, np.float32)
     losses = []
     for _ in range(20):
-        state, loss = train_step(state, u, i, w, cfg)
+        # fresh device buffers per call: train_step donates its batch
+        # tensors, so a reused jnp array would be a deleted buffer on
+        # donation-capable backends
+        state, loss = train_step(state, jnp.asarray(u), jnp.asarray(i),
+                                 jnp.asarray(w), cfg)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9
 

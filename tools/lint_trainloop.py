@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Static check: deep-model train loops ride the prefetched input pipeline.
+
+ISSUE 5 rewired the two-tower and DLRM training loops onto
+``data/prefetch.py``'s :class:`DevicePrefetcher`: batch padding, dtype
+conversion and the H2D transfer run on a background prep thread so the
+transfer overlaps device compute.  That perf win only stays won if
+nothing regresses it — a NEW model (or a refactor of an existing one)
+whose step loop calls ``jnp.asarray`` / ``jax.device_put`` /
+``put_sharded`` inline re-serializes H2D after the device sync and
+silently reopens the feeder-vs-realized gap BENCH_r05 measured.  This
+lint locks the invariant in (same pattern as ``tools/lint_dispatch.py``;
+a tier-1 test runs it in CI):
+
+1. Every module in ``predictionio_tpu/models/`` that defines a
+   ``_train_attempt`` function (the supervised-training-loop convention)
+   must construct a ``DevicePrefetcher`` inside it.
+2. No ``for``-loop body inside such a function may call a staging
+   primitive (``jnp.asarray`` / ``jnp.array`` / ``jax.device_put`` /
+   ``put_sharded``) — staging belongs in the prep closure handed to the
+   prefetcher, where it runs off the step loop.
+
+Usage: ``python tools/lint_trainloop.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# The supervised train-loop entry point both deep models use; any future
+# model following the convention is auto-covered.
+_LOOP_FN = "_train_attempt"
+# Files that MUST define a prefetched _train_attempt (a rename would
+# otherwise silently drop them out of rule 1's reach).
+_REQUIRED = ("two_tower.py", "dlrm.py")
+# Host→device staging primitives banned from step-loop bodies.
+_BANNED_ATTRS = {"asarray", "array", "device_put"}
+_BANNED_NAMES = {"put_sharded", "device_put"}
+
+
+def _is_staging_call(node: ast.Call) -> str:
+    """Name of the banned staging primitive this call is, or ''."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _BANNED_ATTRS:
+        # jnp.asarray / jax.device_put / np-level aliases all count: any
+        # of them materializes a device buffer on the calling thread.
+        if isinstance(f.value, ast.Name) and f.value.id in (
+                "jnp", "jax", "jax_numpy"):
+            return f"{f.value.id}.{f.attr}"
+    if isinstance(f, ast.Name) and f.id in _BANNED_NAMES:
+        return f.id
+    return ""
+
+
+def _constructs_prefetcher(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name == "DevicePrefetcher":
+                return True
+    return False
+
+
+def _loop_staging_calls(fn: ast.FunctionDef) -> List[ast.Call]:
+    """Staging calls lexically inside any for/while loop of ``fn``
+    (including loops in nested helpers — a nested generator staging
+    inline has the same serializing effect)."""
+    bad: List[ast.Call] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_staging_call(sub):
+                bad.append(sub)
+    return bad
+
+
+def check_source(source: str, filename: str,
+                 require_prefetcher: bool = False) -> List[str]:
+    """Violations in one module's source (path:line prefixed strings)."""
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and n.name == _LOOP_FN]
+    if require_prefetcher and not loops:
+        return [f"{filename}:1: no {_LOOP_FN} function — the supervised "
+                f"train-loop convention (and this lint's coverage) "
+                f"requires one"]
+    for fn in loops:
+        if not _constructs_prefetcher(fn):
+            violations.append(
+                f"{filename}:{fn.lineno}: {fn.name} does not construct a "
+                f"DevicePrefetcher — the batch stream must ride the "
+                f"prefetched input pipeline (data/prefetch.py), not "
+                f"stage inline")
+        for call in _loop_staging_calls(fn):
+            violations.append(
+                f"{filename}:{call.lineno}: {fn.name} stages a batch "
+                f"inside the step loop ({_is_staging_call(call)}) — "
+                f"H2D serializes after the device sync; move staging "
+                f"into the DevicePrefetcher prep/put functions")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    """Violations across every model module under ``root``."""
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    models_dir = root / "predictionio_tpu" / "models"
+    violations: List[str] = []
+    for path in sorted(models_dir.glob("*.py")):
+        violations.extend(check_source(
+            path.read_text(encoding="utf-8"), str(path),
+            require_prefetcher=path.name in _REQUIRED))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} train-loop-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_trainloop: deep-model train loops ride DevicePrefetcher.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,20 @@ frontends over real HTTP with concurrent closed-loop clients:
 Usage: python bench_serving.py [--clients 16] [--requests 2000]
 Prints one JSON line per frontend.
 
+With ``--concurrency "1,8,32"`` (ISSUE 6) the bench switches to SWEEP
+mode: one server, several closed-loop concurrency levels, and per level
+it records client p50/p99 NEXT TO the serving scheduler's own counters —
+dispatches-per-request (coalescing), the batch-size distribution, queue
+sheds/rejects, and deadline outcomes (every request carries
+``X-PIO-Deadline-Ms``; a deadline that cannot be met must come back 504,
+never a late 200).  The same levels are then re-driven against an
+unbatched server (``PIO_BATCH_ENABLED=off`` semantics) so the batched
+p99 is judged against the per-request-dispatch baseline at identical
+load.  ``--engine twotower`` runs the sweep against a deep-model engine
+(vectorized ``top_k_scores`` batch predict).  Combined with ``--faults``
+the top level is re-driven with the fault plan installed
+(BENCH_SERVING_r01.json carries clean + faulted rounds).
+
 With ``--faults SPEC`` (PIO_FAULTS grammar, e.g.
 ``http.engine:delay:5ms:0.05``) the python frontend is driven TWICE on
 the same server — clean, then with the fault plan installed — and the
@@ -38,12 +52,11 @@ import urllib.request
 import numpy as np
 
 
-def _setup():
+def _setup(engine_name: str = "als"):
     os.environ.setdefault("PIO_HOME", tempfile.mkdtemp(prefix="pio_bench_"))
     from predictionio_tpu.controller import EngineVariant, RuntimeContext
     from predictionio_tpu.data.event import DataMap, Event
     from predictionio_tpu.data.storage import App, get_storage
-    from predictionio_tpu.templates.recommendation import engine
     from predictionio_tpu.workflow.core_workflow import run_train
 
     storage = get_storage()
@@ -62,12 +75,29 @@ def _setup():
         for u, i, r in zip(users, items, rng.integers(1, 6, 100_000))
     ]
     events.insert_batch(batch, app_id)
-    variant = EngineVariant.from_dict({
-        "engineFactory": "predictionio_tpu.templates.recommendation:engine",
-        "datasource": {"params": {"appName": "benchapp"}},
-        "algorithms": [{"name": "als",
-                        "params": {"rank": 64, "numIterations": 5}}],
-    })
+    if engine_name == "twotower":
+        # Deep-model serving: MLP towers + MIPS top-K, the vectorized
+        # batch_predict the scheduler's coalescing actually exercises.
+        from predictionio_tpu.templates.twotower import engine
+
+        variant = EngineVariant.from_dict({
+            "engineFactory": "predictionio_tpu.templates.twotower:engine",
+            "datasource": {"params": {"appName": "benchapp"}},
+            "algorithms": [{"name": "twotower",
+                            "params": {"embedDim": 16, "hiddenDims": [32],
+                                       "outDim": 16, "epochs": 2,
+                                       "batchSize": 2048}}],
+        })
+    else:
+        from predictionio_tpu.templates.recommendation import engine
+
+        variant = EngineVariant.from_dict({
+            "engineFactory":
+                "predictionio_tpu.templates.recommendation:engine",
+            "datasource": {"params": {"appName": "benchapp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 64, "numIterations": 5}}],
+        })
     eng = engine()
     run_train(eng, variant, ctx)
     return eng, variant, storage, n_users
@@ -221,6 +251,266 @@ def _scrape_server_hist(port: int):
             "server_count": total}
 
 
+# --------------------------------------------------------------------------
+# Sweep mode (ISSUE 6): scheduler coalescing vs concurrency level
+# --------------------------------------------------------------------------
+
+# Deadline mix for sweep drives: (budget_ms, fraction).  The loose tier
+# never sheds; the tight tier exercises the deadline-aware window close +
+# queue shed — any tight request that can't make it must 504, not limp to
+# a late 200.
+_DEADLINE_MIX = ((2000.0, 0.75), (150.0, 0.25))
+# Client-side grace when judging "served late": the closed-loop client's
+# own scheduling/read overhead rides on top of the server-side latency.
+_VIOLATION_GRACE_MS = 50.0
+
+_BATCHER_FAMS = ("pio_batch_dispatch_total", "pio_batch_requests_total",
+                 "pio_queue_rejected_total")
+_BATCH_METRIC_RE = re.compile(
+    r'^(pio_batch_dispatch_total|pio_batch_requests_total|'
+    r'pio_queue_rejected_total)\{model="default"\} (\S+)$|'
+    r'^pio_batch_size_bucket\{model="default",le="([^"]+)"\} (\d+)$|'
+    r'^pio_queue_shed_total\{model="default",reason="([^"]+)"\} (\d+)$')
+
+
+def _scrape_batcher(port: int):
+    """Scheduler flow counters for model "default" (sweep deltas)."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    out = {"counters": {}, "batch_size_bucket": {}, "shed": {}}
+    for line in text.splitlines():
+        m = _BATCH_METRIC_RE.match(line)
+        if not m:
+            continue
+        if m.group(1):
+            out["counters"][m.group(1)] = float(m.group(2))
+        elif m.group(3):
+            out["batch_size_bucket"][m.group(3)] = int(m.group(4))
+        else:
+            out["shed"][m.group(5)] = int(m.group(6))
+    return out
+
+
+def _batcher_delta(before, after):
+    counters = {k: after["counters"].get(k, 0) - before["counters"].get(k, 0)
+                for k in _BATCHER_FAMS}
+    # de-cumulate the le-bucket deltas into per-bin counts
+    cum = {le: after["batch_size_bucket"].get(le, 0)
+           - before["batch_size_bucket"].get(le, 0)
+           for le in after["batch_size_bucket"]}
+    hist, prev = {}, 0
+    for le in sorted(cum, key=lambda v: float(v.replace("+Inf", "inf"))):
+        hist[le] = cum[le] - prev
+        prev = cum[le]
+    shed = {r: after["shed"].get(r, 0) - before["shed"].get(r, 0)
+            for r in set(after["shed"]) | set(before["shed"])}
+    dispatches = counters["pio_batch_dispatch_total"]
+    requests = counters["pio_batch_requests_total"]
+    return {
+        "dispatches": int(dispatches),
+        "requests": int(requests),
+        "dispatches_per_request": (round(dispatches / requests, 4)
+                                   if requests else None),
+        "mean_batch_size": (round(requests / dispatches, 2)
+                            if dispatches else None),
+        "batch_size_dist": {le: n for le, n in sorted(
+            hist.items(), key=lambda kv: float(kv[0].replace("+Inf", "inf")))
+            if n},
+        "rejected_429": int(counters["pio_queue_rejected_total"]),
+        "shed": {k: v for k, v in sorted(shed.items()) if v},
+    }
+
+
+def _drive_level(port: int, n_users: int, clients: int, requests: int):
+    """Closed-loop drive at ONE concurrency level; every request carries
+    a deadline header.  No retries — every status is an outcome the
+    sweep records (a 504 is a shed, not a failure to hide)."""
+    import socket
+
+    rng = np.random.default_rng(2)
+    reqs = []
+    for _ in range(requests):
+        payload = json.dumps({"user": f"u{rng.integers(0, n_users)}",
+                              "num": 10}).encode()
+        roll, budget_ms = rng.random(), _DEADLINE_MIX[0][0]
+        acc = 0.0
+        for ms, frac in _DEADLINE_MIX:
+            acc += frac
+            if roll < acc:
+                budget_ms = ms
+                break
+        raw = (b"POST /queries.json HTTP/1.1\r\nHost: b\r\n"
+               b"Content-Type: application/json\r\n"
+               b"X-PIO-Deadline-Ms: " + str(int(budget_ms)).encode()
+               + b"\r\nContent-Length: " + str(len(payload)).encode()
+               + b"\r\n\r\n" + payload)
+        reqs.append((raw, budget_ms))
+    local = threading.local()
+    _CL = b"content-length:"
+    outcomes = []
+    lock = threading.Lock()
+
+    def one(item):
+        raw, budget_ms = item
+        t0 = time.perf_counter()
+        for attempt in range(3):
+            try:
+                conn = getattr(local, "conn", None)
+                if conn is None:
+                    conn = local.conn = socket.create_connection(
+                        ("127.0.0.1", port), timeout=30)
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                conn.sendall(raw)
+                buf = b""
+                while True:
+                    part = conn.recv(65536)
+                    if not part:
+                        raise OSError("closed")
+                    buf += part
+                    end = buf.find(b"\r\n\r\n")
+                    if end >= 0:
+                        break
+                status = int(buf[9:12])
+                head = buf[:end].lower()
+                i = head.find(_CL)
+                stop = head.find(b"\r", i)
+                if stop < 0:
+                    stop = len(head)
+                need = end + 4 + int(head[i + len(_CL):stop])
+                # Deadline attestation: the server reports the budget it
+                # had left at its late-shed verdict (the budget header
+                # means "remaining budget at receipt"; client wall time
+                # additionally carries transport/backlog queueing).  A
+                # 200 with remaining <= 0 is a served-late violation.
+                j = head.find(b"x-pio-deadline-remaining-ms:")
+                remaining_ms = None
+                if j >= 0:
+                    jstop = head.find(b"\r", j)
+                    try:
+                        remaining_ms = float(
+                            head[j + 28:jstop if jstop > 0 else None])
+                    except ValueError:
+                        pass
+                while len(buf) < need:
+                    part = conn.recv(65536)
+                    if not part:
+                        raise OSError("closed")
+                    buf += part
+                ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    outcomes.append((status, ms, budget_ms, remaining_ms))
+                return
+            except (OSError, ValueError):
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                local.conn = None
+                if attempt == 2:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
+
+    for item in reqs[:5]:   # connection + compile warmup
+        one(item)
+    with lock:
+        outcomes.clear()
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+        list(ex.map(one, reqs))
+    wall = time.perf_counter() - t0
+    ok = np.array([ms for s, ms, _, _ in outcomes if s == 200])
+    statuses = {}
+    for s, _, _, _ in outcomes:
+        statuses[str(s)] = statuses.get(str(s), 0) + 1
+    sent_tight = sum(1 for _, _, b, _ in outcomes if b < 1000)
+    shed_504 = sum(1 for s, _, _, _ in outcomes if s == 504)
+    # served_late_200: the server ATTESTS (X-PIO-Deadline-Remaining-Ms)
+    # its budget was already spent yet it answered 200 anyway — must be
+    # 0 (the transport's late-response shed makes this structural).
+    # client_over_budget_200 additionally counts transport queueing the
+    # deadline header doesn't cover (context, not a violation).
+    served_late = sum(
+        1 for s, _, _, rem in outcomes
+        if s == 200 and rem is not None and rem < 0)
+    client_over = sum(
+        1 for s, ms, b, _ in outcomes
+        if s == 200 and ms > b + _VIOLATION_GRACE_MS)
+    def _pct(p):
+        # A level can come back with ZERO 200s (100% fault plans): the
+        # record says so via null percentiles, not a percentile crash.
+        return round(float(np.percentile(ok, p)), 2) if ok.size else None
+
+    return {
+        "throughput_rps": round(len(outcomes) / wall, 1),
+        "p50_ms": _pct(50),
+        "p95_ms": _pct(95),
+        "p99_ms": _pct(99),
+        "statuses": statuses,
+        "deadlines": {"tight_sent": sent_tight, "shed_504": shed_504,
+                      "served_late_200": served_late,
+                      "client_over_budget_200": client_over},
+    }
+
+
+def _sweep(args) -> None:
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.serving import SchedulerConfig
+
+    levels = [int(x) for x in args.concurrency.split(",") if x.strip()]
+    eng, variant, storage, n_users = _setup(args.engine)
+    record = {"mode": "sweep", "engine": args.engine, "levels": levels,
+              "requests_per_level": args.requests, "rounds": {}}
+
+    srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+    srv.start()
+    batched = []
+    for lvl in levels:
+        before = _scrape_batcher(srv.port)
+        res = _drive_level(srv.port, n_users, lvl, args.requests)
+        res["scheduler"] = _batcher_delta(before, _scrape_batcher(srv.port))
+        res["knobs"] = {k: srv.scheduler.snapshot()["default"][k]
+                        for k in ("windowMs", "maxBatch")}
+        batched.append({"concurrency": lvl, **res})
+        print(json.dumps({"round": "batched", "concurrency": lvl, **res}))
+    record["rounds"]["clean_batched"] = batched
+    if args.faults:
+        # Faulted round at the TOP level, same server/model — the
+        # scheduler must keep coalescing and shedding correctly while
+        # the fault plan stresses the transport.
+        os.environ["PIO_FAULTS"] = args.faults
+        before = _scrape_batcher(srv.port)
+        res = _drive_level(srv.port, n_users, levels[-1], args.requests)
+        res["scheduler"] = _batcher_delta(before, _scrape_batcher(srv.port))
+        os.environ.pop("PIO_FAULTS", None)
+        record["rounds"]["faulted_batched"] = {
+            "concurrency": levels[-1], "faults": args.faults, **res}
+        print(json.dumps({"round": "faulted", **record["rounds"]
+                          ["faulted_batched"]}))
+    srv.stop()
+
+    # Unbatched baseline: identical engine/levels, per-request dispatch
+    # (inline scheduler — admission stays, coalescing goes).
+    srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0,
+                       scheduler_config=SchedulerConfig.from_env(
+                           enabled=False))
+    srv.start()
+    unbatched = []
+    for lvl in levels:
+        res = _drive_level(srv.port, n_users, lvl, args.requests)
+        unbatched.append({"concurrency": lvl, **res})
+        print(json.dumps({"round": "unbatched", "concurrency": lvl,
+                          **res}))
+    srv.stop()
+    record["rounds"]["clean_unbatched"] = unbatched
+
+    for b, u in zip(batched, unbatched):
+        if b["p99_ms"] is not None and u["p99_ms"] is not None:
+            b["p99_vs_unbatched_ms"] = round(b["p99_ms"] - u["p99_ms"], 2)
+    print(json.dumps(record))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -229,7 +519,19 @@ def main():
                     help="fault-injection plan (PIO_FAULTS grammar, e.g. "
                          "'http.engine:delay:5ms:0.01') to measure tail "
                          "latency under injected partial failure")
+    ap.add_argument("--concurrency", default=None, metavar="LEVELS",
+                    help="comma-separated concurrency levels — sweep the "
+                         "serving scheduler on one server (e.g. "
+                         "'1,8,32,64') and record coalescing + p50/p99 "
+                         "per level vs the unbatched baseline")
+    ap.add_argument("--engine", default="als",
+                    choices=("als", "twotower"),
+                    help="engine for the sweep (twotower = deep model)")
     args = ap.parse_args()
+
+    if args.concurrency:
+        _sweep(args)
+        return
 
     eng, variant, storage, n_users = _setup()
     from predictionio_tpu.server import EngineServer

@@ -188,6 +188,11 @@ def _print_serving_snapshot(lines) -> None:
     reloads = {}
     breakers = {}
     watchdog = {}
+    batcher = {}
+
+    def _b(model):
+        return batcher.setdefault(model, {})
+
     for name, labels, value in _parse_metric_lines(lines):
         if name == "pio_model_generation":
             generation = int(value)
@@ -198,7 +203,22 @@ def _print_serving_snapshot(lines) -> None:
                 _BREAKER_STATES.get(int(value), str(value))
         elif name == "pio_watchdog_fired_total" and value > 0:
             watchdog[labels.get("fn", "?")] = int(value)
-    if generation is None and not reloads and not breakers:
+        elif name == "pio_batch_window_ms":
+            _b(labels.get("model", "?"))["window_ms"] = value
+        elif name == "pio_batch_max_size":
+            _b(labels.get("model", "?"))["max"] = int(value)
+        elif name == "pio_queue_depth":
+            _b(labels.get("model", "?"))["queued"] = int(value)
+        elif name == "pio_batch_dispatch_total":
+            _b(labels.get("model", "?"))["dispatches"] = int(value)
+        elif name == "pio_batch_requests_total":
+            _b(labels.get("model", "?"))["requests"] = int(value)
+        elif name == "pio_queue_rejected_total" and value > 0:
+            _b(labels.get("model", "?"))["rejected"] = int(value)
+        elif name == "pio_queue_shed_total" and value > 0:
+            shed = _b(labels.get("model", "?")).setdefault("shed", {})
+            shed[labels.get("reason", "?")] = int(value)
+    if generation is None and not reloads and not breakers and not batcher:
         return
     if generation is not None:
         print(f"serving: model generation {generation}")
@@ -209,6 +229,20 @@ def _print_serving_snapshot(lines) -> None:
         print(f"  breaker [{b}]: {st}")
     for fn, n in sorted(watchdog.items()):
         print(f"  watchdog fired [{fn}]: {n}")
+    # Batcher snapshot (ISSUE 6): coalescing health per model lane.
+    for model, row in sorted(batcher.items()):
+        disp, reqs = row.get("dispatches", 0), row.get("requests", 0)
+        parts = [f"window {row.get('window_ms', 0):g}ms",
+                 f"max {row.get('max', '?')}",
+                 f"queued {row.get('queued', 0)}",
+                 f"requests {reqs}", f"dispatches {disp}"]
+        if disp:
+            parts.append(f"mean batch {reqs / disp:.2f}")
+        if row.get("rejected"):
+            parts.append(f"rejected(429) {row['rejected']}")
+        for reason, n in sorted(row.get("shed", {}).items()):
+            parts.append(f"shed[{reason}] {n}")
+        print(f"  batcher [{model}]: {', '.join(parts)}")
 
 
 # --------------------------------------------------------------------------
@@ -537,6 +571,7 @@ def cmd_deploy(args) -> int:
     from predictionio_tpu.controller import EngineVariant, load_engine_factory
     from predictionio_tpu.parallel.distributed import initialize_distributed
     from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.serving import SchedulerConfig
 
     initialize_distributed()
     variant_path = Path(args.engine_json)
@@ -544,9 +579,19 @@ def cmd_deploy(args) -> int:
         _die(f"{variant_path} not found (expected an engine.json).")
     variant = EngineVariant.from_file(variant_path)
     engine = load_engine_factory(variant.engine_factory)()
+    # Serving-scheduler knobs: flags override the PIO_BATCH_*/PIO_QUEUE_*
+    # env (SchedulerConfig.from_env ignores None overrides).
+    sched_cfg = SchedulerConfig.from_env(
+        enabled=False if args.no_batcher else None,
+        window_ms=args.batch_window_ms,
+        queue_depth=args.queue_depth,
+        max_batch=args.max_batch,
+        p99_target_ms=args.batch_p99_target_ms,
+    )
     srv = EngineServer(
         engine, variant, _storage(), host=args.ip, port=args.port,
         instance_id=args.engine_instance_id, mesh_spec=args.mesh,
+        scheduler_config=sched_cfg,
     )
     if args.native:
         from predictionio_tpu.native.frontend import NativeFrontend
@@ -570,8 +615,10 @@ def cmd_deploy(args) -> int:
                 return 200, {"status": "stopping"}
             return srv.handle(method, path, body)
 
+        # Same batch ceiling as the scheduler config (flag beats
+        # PIO_BATCH_MAX beats 64) — one knob, both batching stacks.
         fe = NativeFrontend(srv.query_batch, host=args.ip, port=args.port,
-                            max_batch=args.max_batch,
+                            max_batch=sched_cfg.max_batch,
                             max_wait_us=args.max_wait_us,
                             fallback=engine_fallback,
                             plugin_hook=(srv.plugins.header_block
@@ -584,7 +631,7 @@ def cmd_deploy(args) -> int:
         port = fe.start()
         print(f"Native engine frontend on {args.ip}:{port} "
               f"(instance {srv._instance.id}; continuous batching "
-              f"≤{args.max_batch}; Ctrl-C to stop)")
+              f"≤{sched_cfg.max_batch}; Ctrl-C to stop)")
         try:
             stop_event.wait()
         except KeyboardInterrupt:
@@ -1076,8 +1123,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device mesh for model re-load/serve sharding")
     d.add_argument("--native", action="store_true",
                    help="serve via the C++ continuous-batching frontend")
-    d.add_argument("--max-batch", type=int, default=64)
+    d.add_argument("--max-batch", type=int, default=None,
+                   help="max queries per batched dispatch — applies to "
+                        "the serving scheduler AND the native frontend "
+                        "(default env PIO_BATCH_MAX, else 64)")
     d.add_argument("--max-wait-us", type=int, default=2000)
+    d.add_argument("--no-batcher", action="store_true",
+                   help="disable the serving micro-batcher (per-request "
+                        "dispatch; admission control stays on)")
+    d.add_argument("--batch-window-ms", dest="batch_window_ms", type=float,
+                   default=None,
+                   help="initial batch gather window (default env "
+                        "PIO_BATCH_WINDOW_MS, else 2.0; autotuned live)")
+    d.add_argument("--queue-depth", dest="queue_depth", type=int,
+                   default=None,
+                   help="admission queue depth; full queue answers 429 "
+                        "(default env PIO_QUEUE_DEPTH, else 128)")
+    d.add_argument("--batch-p99-target-ms", dest="batch_p99_target_ms",
+                   type=float, default=None,
+                   help="autotuner served-latency p99 target (default env "
+                        "PIO_BATCH_P99_TARGET_MS, else 100)")
     d.set_defaults(fn=cmd_deploy)
 
     bp = sub.add_parser("batchpredict", help="bulk predict from NDJSON queries")

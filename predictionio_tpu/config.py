@@ -33,9 +33,19 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Dict, Mapping, Optional
 
-__all__ = ["StorageSourceConfig", "RepositoryConfig", "PioConfig", "load_config", "pio_home"]
+__all__ = ["StorageSourceConfig", "RepositoryConfig", "PioConfig",
+           "load_config", "pio_home", "env_bool"]
 
 _REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+
+def env_bool(raw: Optional[str], default: bool) -> bool:
+    """THE boolean env-var dialect (``PIO_BATCH_ENABLED``,
+    ``PIO_RETAIN_PREVIOUS``, ...): unset/empty → ``default``; otherwise
+    anything but ``0/off/false/no`` (case-insensitive) is true."""
+    if raw is None or raw.strip() == "":
+        return default
+    return raw.strip().lower() not in ("0", "off", "false", "no")
 
 
 def pio_home(env: Optional[Mapping[str, str]] = None) -> Path:

@@ -47,6 +47,7 @@ from predictionio_tpu.obs.runtime import (
 from predictionio_tpu.obs.trace import (
     Span,
     TraceRecorder,
+    attach_event,
     current_span,
     current_trace_id,
     get_recorder,
@@ -78,6 +79,7 @@ __all__ = [
     "track_compiles",
     "Span",
     "TraceRecorder",
+    "attach_event",
     "current_span",
     "current_trace_id",
     "get_recorder",

@@ -47,6 +47,7 @@ __all__ = [
     "Span",
     "span",
     "trace",
+    "attach_event",
     "current_span",
     "current_trace_id",
     "new_trace_id",
@@ -172,6 +173,27 @@ class span:
         if self._token is not None:
             _current_span.reset(self._token)
         return False
+
+
+def attach_event(parent: Optional[Span], name: str, **attrs) -> Span:
+    """Zero-duration annotation on an EXPLICIT parent span.
+
+    ``obs.runtime.publish_event`` attaches to the caller's contextvar
+    span — useless for cross-thread producers like the serving
+    micro-batcher, which annotates REQUEST spans from its own dispatcher
+    thread.  The caller guarantees the parent's owning thread is parked
+    (the request handler blocks on its pending result while the batcher
+    writes), so the child append needs no lock.  ``parent=None`` records
+    a standalone single-span trace instead, so the evidence is never
+    silently dropped.
+    """
+    ev = Span(name, attrs)
+    ev.duration_ms = 0.0
+    if parent is not None:
+        parent.children.append(ev)
+        return ev
+    get_recorder().record(new_trace_id(), ev)
+    return ev
 
 
 @contextlib.contextmanager

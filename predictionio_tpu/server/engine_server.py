@@ -50,6 +50,14 @@ from predictionio_tpu.server.http import (
     ThreadingHTTPServer,
     timeline_payload,
 )
+from predictionio_tpu.config import env_bool
+from predictionio_tpu.serving import (
+    QueueFull,
+    SchedulerClosed,
+    SchedulerConfig,
+    SchedulerStalled,
+    ServingScheduler,
+)
 from predictionio_tpu.version import __version__
 from predictionio_tpu.workflow.core_workflow import (
     WorkflowError,
@@ -171,6 +179,7 @@ class EngineServer:
         mesh_spec: Optional[str] = None,
         plugins=None,
         breaker: Optional[CircuitBreaker] = None,
+        scheduler_config: Optional[SchedulerConfig] = None,
     ):
         from predictionio_tpu.server.plugins import PluginManager
 
@@ -196,7 +205,7 @@ class EngineServer:
         self._models: List[Any] = []
         self._serving = None
         self._loaded_at: Optional[_dt.datetime] = None
-        self._init_lifecycle_state(breaker)
+        self._init_lifecycle_state(breaker, scheduler_config)
         self.reload()
         # Server plugin seam (reference: EngineServerPlugin, SURVEY §5.1).
         # Started LAST — after reload() — so plugins see a fully
@@ -207,12 +216,15 @@ class EngineServer:
 
     # -- model lifecycle ----------------------------------------------------
 
-    def _init_lifecycle_state(self,
-                              breaker: Optional[CircuitBreaker] = None
-                              ) -> None:
-        """Staged-reload state: lock, generations, breaker, instruments.
-        Factored out of ``__init__`` so test skeletons built with
-        ``__new__`` (tests/test_resilience.py) stay in lock-step."""
+    def _init_lifecycle_state(
+            self,
+            breaker: Optional[CircuitBreaker] = None,
+            scheduler_config: Optional[SchedulerConfig] = None) -> None:
+        """Staged-reload state + the serving scheduler: lock, generations,
+        breaker, instruments.  Factored out of ``__init__`` so test
+        skeletons built with ``__new__`` (tests/test_resilience.py) stay
+        in lock-step — their ``/queries.json`` calls ride the same
+        admission queue + micro-batcher as production."""
         self._reload_lock = threading.Lock()  # serialize staged reloads
         self._generation = 0
         self._previous: Optional[_Generation] = None
@@ -228,6 +240,17 @@ class EngineServer:
                 "PIO_BREAKER_RECOVERY_S", "10")),
             failure_types=(StorageUnavailable, ConnectionError))
         self.retry_after_s = int(os.environ.get("PIO_RETRY_AFTER_S", "5"))
+        # Retained-previous policy (ROADMAP carry-forward: the rollback
+        # generation doubles model memory while it lives).  off = never
+        # retain; TTL > 0 = drop it after the canary window.
+        self._retain_previous = env_bool(
+            os.environ.get("PIO_RETAIN_PREVIOUS"), True)
+        try:
+            self._retain_ttl_s = float(
+                os.environ.get("PIO_RETAIN_PREVIOUS_TTL_S", "0") or 0)
+        except ValueError:
+            self._retain_ttl_s = 0.0
+        self._evict_timer: Optional[threading.Timer] = None
         reg = self.stats.registry
         self._reload_total = reg.counter(
             "pio_model_reload_total",
@@ -236,6 +259,19 @@ class EngineServer:
             "pio_model_generation",
             "Monotonic generation of the model currently serving "
             "(bumped by every successful reload or rollback).")
+        self._prev_retained = reg.gauge(
+            "pio_model_previous_retained",
+            "1 while a rollback generation is held in memory.")
+        self._prev_evicted = reg.counter(
+            "pio_model_previous_evicted_total",
+            "Rollback generations dropped by the PIO_RETAIN_PREVIOUS_TTL_S "
+            "eviction timer.")
+        # Serving scheduler (ISSUE 6): every /queries.json rides the
+        # admission queue + micro-batcher; handlers never reach the
+        # model directly (tools/lint_dispatch.py pins this).
+        self.scheduler = ServingScheduler(
+            config=scheduler_config or SchedulerConfig.from_env())
+        self.scheduler.register("default", self._dispatch_batch)
 
     def _load_candidate(self):
         """Storage-read phase of the staged reload (runs under the
@@ -326,10 +362,14 @@ class EngineServer:
                 raise
             now = _dt.datetime.now(_dt.timezone.utc)
             with self._swap_lock:
-                if self._instance is not None:
-                    self._previous = _Generation(
-                        self._instance, self._models, self._algorithms,
-                        self._serving, self._loaded_at, self._generation)
+                # PIO_RETAIN_PREVIOUS=off: never hold a second generation
+                # in memory (large corpora double their footprint while a
+                # rollback generation lives).
+                self._previous = _Generation(
+                    self._instance, self._models, self._algorithms,
+                    self._serving, self._loaded_at, self._generation) \
+                    if self._instance is not None and self._retain_previous \
+                    else None
                 self._instance = instance
                 self._models = models
                 self._algorithms = algorithms
@@ -337,7 +377,10 @@ class EngineServer:
                 self._loaded_at = now
                 self._generation += 1
                 gen = self._generation
+                retained = self._previous is not None
             self._gen_gauge.set(gen)
+            self._prev_retained.set(1 if retained else 0)
+            self._arm_eviction(gen)
             self._record_reload("ok", instance=instance.id, generation=gen)
             logger.info("Engine server loaded instance %s (generation %d)",
                         instance.id, gen)
@@ -366,11 +409,55 @@ class EngineServer:
                 gen = self._generation
                 instance_id = prev.instance.id
             self._gen_gauge.set(gen)
+            self._prev_retained.set(1)
+            # The rolled-from generation now sits in the previous slot;
+            # it ages out on the same TTL as any other retained one.
+            self._arm_eviction(gen)
             self._record_reload("rollback", instance=instance_id,
                                 generation=gen)
             logger.warning("Engine server rolled back to instance %s "
                            "(generation %d)", instance_id, gen)
             return instance_id
+
+    def _arm_eviction(self, generation: int) -> None:
+        """(Re)start the retained-previous TTL timer for ``generation``.
+
+        The timer carries the generation it was armed for: if a newer
+        reload/rollback swapped again before it fires, the stale timer's
+        eviction is a no-op (the new swap armed its own)."""
+        timer, self._evict_timer = self._evict_timer, None
+        if timer is not None:
+            timer.cancel()
+        if self._retain_ttl_s <= 0:
+            return
+        with self._swap_lock:
+            if self._previous is None:
+                return
+        timer = threading.Timer(self._retain_ttl_s, self._evict_previous,
+                                args=(generation,))
+        timer.daemon = True
+        timer.start()
+        self._evict_timer = timer
+
+    def _evict_previous(self, expected_generation: int) -> bool:
+        """Drop the retained rollback generation (frees its model memory)
+        — called by the TTL timer after the canary window, or directly.
+        Returns False when a newer swap already owns the previous slot."""
+        with self._swap_lock:
+            if (self._generation != expected_generation
+                    or self._previous is None):
+                return False
+            dropped = self._previous
+            self._previous = None
+        self._prev_retained.set(0)
+        self._prev_evicted.inc()
+        publish_event("model.previous_evicted",
+                      generation=expected_generation,
+                      evicted_generation=dropped.number)
+        logger.info("Evicted retained previous model generation %d after "
+                    "%.0fs TTL (rollback no longer available)",
+                    dropped.number, self._retain_ttl_s)
+        return True
 
     # -- query path ---------------------------------------------------------
 
@@ -418,15 +505,23 @@ class EngineServer:
                 self._algorithms, self._models, self._serving)
         return self._predict_with(algorithms, models, serving, query_json)
 
-    def query_batch(self, query_jsons: List[Any]) -> List[Any]:
-        """Batched predict for the native continuous-batching frontend:
-        one ``batch_predict`` (vectorized XLA) call per algorithm instead of
-        a per-request loop."""
+    def _dispatch_batch(self, bound_queries: List[Any]
+                        ) -> Tuple[List[Any], int]:
+        """THE batched dispatch the serving scheduler drives: one
+        ``batch_predict`` (vectorized XLA) call per algorithm for the
+        whole cohort, against ONE generation snapshot taken under a
+        single swap-lock acquisition — a reload/rollback landing
+        mid-batch flips the next batch, never splits this one.
+
+        Takes BOUND queries: binding is per-member, client-controlled
+        failure, so it happens at admission (handler thread → its own
+        400) and can never fail a cohort.  ``supplement`` stays here —
+        it belongs to the generation's serving instance."""
         with self._swap_lock:
-            algorithms, models, serving = (
-                self._algorithms, self._models, self._serving)
-        queries = [serving.supplement(self._bind_query(qj))
-                   for qj in query_jsons]
+            algorithms, models, serving, generation = (
+                self._algorithms, self._models, self._serving,
+                self._generation)
+        queries = [serving.supplement(q) for q in bound_queries]
         indexed = list(enumerate(queries))
         per_algo = [dict(a.batch_predict(m, indexed))
                     for a, m in zip(algorithms, models)]
@@ -434,7 +529,13 @@ class EngineServer:
             self._result_to_json(
                 serving.serve(q, [pa[i] for pa in per_algo]))
             for i, q in indexed
-        ]
+        ], generation
+
+    def query_batch(self, query_jsons: List[Any]) -> List[Any]:
+        """Batched predict (native frontend, ``pio batchpredict``): the
+        scheduler's dispatch path without the generation tag."""
+        return self._dispatch_batch(
+            [self._bind_query(qj) for qj in query_jsons])[0]
 
     # -- HTTP ---------------------------------------------------------------
 
@@ -459,7 +560,9 @@ class EngineServer:
                     "modelGeneration": gen,
                     "lastReload": self._last_reload or None,
                     "rollbackAvailable": prev is not None,
+                    "retainPreviousTtlS": self._retain_ttl_s or None,
                     "breaker": self._breaker.state,
+                    "batcher": self.scheduler.snapshot(),
                     "version": __version__,
                 }
             if path == "/ready" and method == "GET":
@@ -477,7 +580,8 @@ class EngineServer:
                 # THE process-wide exposition (shared registry render).
                 return 200, self.stats.registry.render()
             if path == "/stats.json" and method == "GET":
-                return 200, self.stats.snapshot()
+                return 200, {**self.stats.snapshot(),
+                             "batcher": self.scheduler.snapshot()}
             if path == "/traces.json" and method == "GET":
                 return 200, {"traces": get_recorder().recent(50)}
             if path == "/timeline.json" and method == "GET":
@@ -507,17 +611,37 @@ class EngineServer:
             if path == "/queries.json" and method == "POST":
                 t0 = time.perf_counter()
                 try:
-                    # Shed BEFORE binding/predicting: a request whose
-                    # budget is spent must not burn an algorithm pass.
+                    # Shed BEFORE admission: a request whose budget is
+                    # spent must not occupy a queue slot.
                     _deadline.check("predict")
-                    obj = json.loads(body.decode("utf-8"))
-                    result = self.query(obj)
+                    # Bind BEFORE admission: a malformed query 400s on
+                    # this thread and never occupies a queue slot or
+                    # fails the batch it would have ridden in.
+                    q = self._bind_query(json.loads(body.decode("utf-8")))
+                    # The ONLY route to the model: admission queue →
+                    # micro-batcher → vectorized dispatch (ISSUE 6; the
+                    # lint forbids calling query/query_batch from here).
+                    result = self.scheduler.submit_and_wait("default", q)
+                    # Final gate: a result that arrived past its own
+                    # deadline is never served as a slow 200 — the
+                    # client's budget is spent, so it gets the same 504
+                    # the waiter would have raised a tick later.
+                    _deadline.check("respond")
                     self.stats.record((time.perf_counter() - t0) * 1e3, True)
                     return 200, result
+                except QueueFull as e:
+                    # Admission rejected: 429 + Retry-After (the handler
+                    # adds the hint via retry_after_statuses) — back off,
+                    # the requests already admitted keep their latency.
+                    self.stats.record((time.perf_counter() - t0) * 1e3, False)
+                    return 429, {"message": str(e)}
                 except DeadlineExceeded as e:
                     self.stats.shed.inc(server="engine")
                     self.stats.record((time.perf_counter() - t0) * 1e3, False)
                     return 504, {"message": str(e)}
+                except (SchedulerStalled, SchedulerClosed) as e:
+                    self.stats.record((time.perf_counter() - t0) * 1e3, False)
+                    return 503, {"message": f"Temporarily unavailable: {e}"}
                 except (QueryError, json.JSONDecodeError) as e:
                     self.stats.record((time.perf_counter() - t0) * 1e3, False)
                     return 400, {"message": str(e)}
@@ -546,9 +670,15 @@ class EngineServer:
         class Handler(BaseHandler):
             server_log_name = "engine-server"
             trace_server_name = "engine"
+            # Predicts are read-only: a 200 computed past its budget is
+            # safely rewritten to 504 at the transport (never-late-200).
+            shed_late_responses = True
 
             def pio_handle(self, method, path, params, body):
                 return server_self.handle(method, path, body, params)
+
+            def pio_shed(self):
+                server_self.stats.shed.inc(server="engine")
 
             def pio_on_complete(self, method, path, status, ms, body,
                                 params):
@@ -588,4 +718,8 @@ class EngineServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._evict_timer is not None:
+            self._evict_timer.cancel()
+            self._evict_timer = None
+        self.scheduler.close()
         self.plugins.stop()

@@ -147,8 +147,17 @@ class BaseHandler(BaseHTTPRequestHandler):
     # (the event server's pre-auth shed; the engine server sheds inside
     # its handler, right before the expensive predict, instead).
     shed_pre_handle = False
-    # Degraded answers that carry the Retry-After backoff hint.
-    retry_after_statuses = (202, 503)
+    # Rewrite a 2xx whose budget ran out DURING handling into a 504
+    # (ISSUE 6: an expired request gets 504, never a slow 200).  The
+    # verdict and the X-PIO-Deadline-Remaining-Ms attestation are ONE
+    # measurement, so a 200 always attests positive remaining budget.
+    # Only safe on non-mutating frontends — the engine server opts in;
+    # an event-server write that SUCCEEDED must report its success.
+    shed_late_responses = False
+    # Degraded answers that carry the Retry-After backoff hint: spill
+    # accepts (202), admission rejections (429), and unavailability
+    # (503) all want the client to come back, just later.
+    retry_after_statuses = (202, 429, 503)
 
     # -- per-frontend hooks --------------------------------------------------
 
@@ -188,6 +197,7 @@ class BaseHandler(BaseHTTPRequestHandler):
             with span("http.read"):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+            remaining: Optional[float] = None
             with _deadline.deadline_scope(
                     incoming_deadline_ms(self.headers)):
                 if self.shed_pre_handle and _deadline.exceeded():
@@ -200,15 +210,37 @@ class BaseHandler(BaseHTTPRequestHandler):
                     with span("http.handle"):
                         out = self.pio_handle(method, parsed.path, params,
                                               body)
+                    remaining = _deadline.remaining_ms()
             if len(out) == 3:
                 status, payload, ctype = out  # type: ignore[misc]
             else:
                 status, payload = out  # type: ignore[misc]
                 ctype = None
+            if (self.shed_late_responses and remaining is not None
+                    and remaining <= 0 and 200 <= status < 300):
+                # The handler answered, but past its budget: the client
+                # stopped waiting — 504, not a slow 2xx (see class attr).
+                self.pio_shed()
+                status, payload, ctype = 504, {
+                    "message": "Deadline exceeded before response."}, None
             troot.set(status=status)
             ms = (time.perf_counter() - t0) * 1e3
             extra = dict(self.pio_on_complete(method, parsed.path, status,
                                               ms, body, params) or {})
+            # The server's own read+handle wall time: clients (and the
+            # serving bench) use it to attribute client-vs-server latency
+            # drift and to ATTEST deadline compliance — a 200 whose
+            # X-PIO-Server-Ms is inside the sent budget was served in
+            # time by the server's clock, whatever transport queueing
+            # added around it.
+            extra.setdefault("X-PIO-Server-Ms", f"{ms:.1f}")
+            if remaining is not None:
+                # Deadline attestation: the SAME reading the late-shed
+                # verdict used — a 200 always carries remaining > 0
+                # (though formatting may floor a sliver to 0.00, so
+                # verifiers must treat only NEGATIVE values as late).
+                extra.setdefault("X-PIO-Deadline-Remaining-Ms",
+                                 f"{remaining:.2f}")
             retry_after = self.pio_retry_after_s()
             if retry_after is not None and status in self.retry_after_statuses:
                 extra.setdefault("Retry-After", str(retry_after))

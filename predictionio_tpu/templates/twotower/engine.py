@@ -158,6 +158,42 @@ class TwoTowerAlgorithm(Algorithm):
             ItemScore(item=inv[int(i)], score=float(s))
             for s, i in zip(scores[0], ids[0])])
 
+    def batch_predict(self, model: TwoTowerModelWrapper, queries):
+        """Vectorized serving path for the continuous-batching scheduler:
+        ONE ``top_k_scores`` dispatch for the whole cohort.
+
+        Batch and K are padded to small menus (powers of two / the ALS
+        template's K menu) so the serving frontend's varying batch sizes
+        hit a handful of compiled XLA programs instead of compiling per
+        distinct shape (SURVEY.md §7).
+        """
+        known = [(i, q) for i, q in queries
+                 if model.user_index.get(q.user) is not None]
+        out = [(i, PredictedResult(itemScores=[])) for i, q in queries
+               if model.user_index.get(q.user) is None]
+        if not known:
+            return out
+        n_items = model.item_vecs.shape[0]
+        num = max(q.num for _, q in known)
+        k_menu = (1, 10, 100, 1000)
+        k = min(n_items, next((m for m in k_menu if m >= num), num))
+        idxs = np.asarray([model.user_index[q.user] for _, q in known])
+        qvecs = model.user_vecs[idxs]
+        pad = (1 << max(len(idxs) - 1, 0).bit_length()) - len(idxs)
+        if pad:
+            qvecs = np.concatenate(
+                [qvecs, np.zeros((pad, qvecs.shape[1]), qvecs.dtype)])
+        scores, ids = top_k_scores(
+            jnp.asarray(qvecs), jnp.asarray(model.item_vecs), k)
+        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
+        inv = model.item_index.inverse
+        for row, (i, q) in enumerate(known):
+            kk = min(q.num, n_items)
+            out.append((i, PredictedResult(itemScores=[
+                ItemScore(item=inv[int(ii)], score=float(ss))
+                for ss, ii in zip(scores[row][:kk], ids[row][:kk])])))
+        return out
+
 
 def engine() -> Engine:
     return Engine(

@@ -64,6 +64,40 @@ def _make_handler(server_self):
     assert any("Handler.do_GET" in v for v in violations)
 
 
+def test_detects_direct_model_dispatch_from_handlers():
+    """ISSUE 6 rule 4: handlers reach the model ONLY through the serving
+    scheduler — a do_*/pio_handle/handle body calling .query()/
+    .query_batch() is flagged wherever the class lives."""
+    src = """
+class SomeServer:
+    def handle(self, method, path, body):
+        if path == "/queries.json":
+            return 200, self.query_batch([1])
+        return 200, self.engine.query({"u": 1})
+
+    def _dispatch_batch(self, qs):
+        return self.query_batch(qs), 1  # NOT a handler: sanctioned
+"""
+    violations = lint_dispatch.check_source(src, "srv.py")
+    assert len(violations) == 2
+    assert all("serving scheduler" in v for v in violations)
+    assert any(".query_batch" in v for v in violations)
+    assert any(".query(" in v for v in violations)
+
+
+def test_handler_via_scheduler_is_clean():
+    src = """
+class SomeServer:
+    def handle(self, method, path, body):
+        return 200, self.scheduler.submit_and_wait("default", body)
+
+class Handler(BaseHandler):
+    def do_POST(self):
+        self.dispatch("POST")
+"""
+    assert lint_dispatch.check_source(src, "srv.py") == []
+
+
 def test_main_exit_codes(tmp_path, capsys):
     assert lint_dispatch.main([str(REPO)]) == 0
     server_dir = tmp_path / "predictionio_tpu" / "server"

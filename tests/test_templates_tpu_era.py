@@ -57,6 +57,34 @@ class TestTwoTowerTemplate:
         assert even >= 4
         assert algo.predict(models[0], Query(user="ghost")).itemScores == []
 
+    def test_batch_predict_matches_per_query(self, ctx):
+        """ISSUE 6: the vectorized serving path (one top_k_scores for the
+        whole cohort, pow2-padded) must agree with predict() per query —
+        unknown users included — so the micro-batcher changes latency,
+        never answers."""
+        import numpy as np
+
+        from predictionio_tpu.templates.twotower import Query, engine
+
+        _seed_views(ctx)
+        eng = engine()
+        variant = EngineVariant.from_dict(self.VARIANT)
+        inst = ctx.storage.get_engine_instances().get(
+            run_train(eng, variant, ctx))
+        models = load_models(eng, inst, ctx)
+        algo = eng.make_algorithms(eng.bind_engine_params(self.VARIANT))[0]
+        queries = [Query(user="u0", num=5), Query(user="ghost", num=3),
+                   Query(user="u1", num=2), Query(user="u2", num=12)]
+        batched = dict(algo.batch_predict(models[0],
+                                          list(enumerate(queries))))
+        for i, q in enumerate(queries):
+            single = algo.predict(models[0], q)
+            assert [s.item for s in batched[i].itemScores] == \
+                [s.item for s in single.itemScores]
+            assert np.allclose(
+                [s.score for s in batched[i].itemScores],
+                [s.score for s in single.itemScores], atol=1e-5)
+
 
 class TestDLRMTemplate:
     VARIANT = {

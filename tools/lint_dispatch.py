@@ -17,6 +17,13 @@ locks the invariant in (ISSUE 4 satellite; a tier-1 test runs it in CI):
 3. No ``do_<METHOD>`` body may call ``self.send_response`` /
    ``self.wfile.write`` directly — replying outside ``dispatch``/
    ``respond`` bypasses the shared headers.
+4. (ISSUE 6) No request-handler function (``do_*``, ``pio_handle``, or a
+   server's ``handle``) may call ``.query(...)``/``.query_batch(...)``
+   directly — the model is reached ONLY through the serving scheduler
+   (``predictionio_tpu/serving``), so every query rides admission
+   control, the deadline-aware micro-batcher, and its metrics.  A
+   handler that dispatches directly silently forfeits coalescing AND
+   admission control under load.
 
 Usage: ``python tools/lint_dispatch.py [root]`` — prints violations and
 exits non-zero when any exist.
@@ -33,6 +40,10 @@ from typing import List
 _GOOD_BASES = {"BaseHandler"}
 # Subclassing these directly is the violation rule 1 catches.
 _RAW_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+# Rule 4: functions on the request path (any server's handler surface).
+_HANDLER_FN_NAMES = {"pio_handle", "handle"}
+# Rule 4: the model-dispatch methods only the serving scheduler may call.
+_DIRECT_DISPATCH = {"query", "query_batch"}
 
 
 def _base_names(cls: ast.ClassDef) -> List[str]:
@@ -74,6 +85,22 @@ def _direct_write_calls(fn: ast.FunctionDef) -> List[str]:
     return bad
 
 
+def _direct_dispatch_calls(fn: ast.FunctionDef) -> List[str]:
+    """Rule 4: ``<anything>.query(...)`` / ``<anything>.query_batch(...)``
+    calls inside a request-handler function."""
+    bad = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DIRECT_DISPATCH):
+            bad.append(f".{node.func.attr}")
+    return bad
+
+
+def _is_handler_fn(fn: ast.FunctionDef) -> bool:
+    return fn.name.startswith("do_") or fn.name in _HANDLER_FN_NAMES
+
+
 def check_source(source: str, filename: str) -> List[str]:
     """Violations in one module's source (path:line prefixed strings)."""
     violations: List[str] = []
@@ -81,6 +108,18 @@ def check_source(source: str, filename: str) -> List[str]:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as e:
         return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    for node in ast.walk(tree):
+        # Rule 4 applies to EVERY handler-surface function, whether or
+        # not it lives in a BaseHandler subclass (the servers' `handle`
+        # methods are plain class methods the Handler delegates to).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_handler_fn(node):
+            for call in _direct_dispatch_calls(node):
+                violations.append(
+                    f"{filename}:{node.lineno}: {node.name} calls "
+                    f"{call}(...) directly — the model is reached only "
+                    f"through the serving scheduler "
+                    f"(ServingScheduler.submit_and_wait)")
     for node in ast.walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue

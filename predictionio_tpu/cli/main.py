@@ -432,6 +432,23 @@ def cmd_train(args) -> int:
         # Overlapped input pipeline (data/prefetch.py): the deep-model
         # train loops read this when constructing their DevicePrefetcher.
         os.environ["PIO_PREFETCH_DEPTH"] = str(args.prefetch_depth)
+    if getattr(args, "fuse_steps", None):
+        # K-step fused dispatch (data/fusion.py): an int pins the scan
+        # depth, "auto" hands it to the HBM-guided autotuner.
+        text = str(args.fuse_steps).strip().lower()
+        if text != "auto":
+            try:
+                if int(text) < 1:
+                    _die("--fuse-steps must be a positive integer or "
+                         "'auto'.")
+            except ValueError:
+                _die(f"--fuse-steps {args.fuse_steps!r} is neither an "
+                     "integer nor 'auto'.")
+        os.environ["PIO_FUSE_STEPS"] = text
+    if getattr(args, "batch_autoscale", False):
+        # Opt-in: wider (concatenated) optimizer steps once fusion depth
+        # caps out — a semantics change, so never on by default.
+        os.environ["PIO_BATCH_AUTOSCALE"] = "on"
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -1096,6 +1113,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the device (default: env PIO_PREFETCH_DEPTH, "
                         "else 2; raise on fast-feeder/slow-step "
                         "workloads, lower if HBM headroom warns)")
+    t.add_argument("--fuse-steps", dest="fuse_steps", default=None,
+                   metavar="K|auto",
+                   help="optimizer steps fused into one XLA dispatch "
+                        "(lax.scan over a K-batch superbatch; "
+                        "bitwise-equal to K=1).  'auto' grows depth "
+                        "until the HBM headroom guardrail pushes back, "
+                        "then backs off one notch and pins (default: "
+                        "env PIO_FUSE_STEPS, else 1)")
+    t.add_argument("--batch-autoscale", dest="batch_autoscale",
+                   action="store_true",
+                   help="let the fusion autotuner also widen the "
+                        "effective batch (concatenate prepped batches) "
+                        "once fusion depth caps out — fewer, wider "
+                        "optimizer steps: a semantics change, opt-in "
+                        "(env PIO_BATCH_AUTOSCALE=on)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="evaluate engine-params candidates")

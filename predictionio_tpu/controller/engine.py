@@ -193,7 +193,8 @@ class Engine:
                     indexed = list(enumerate(q for q, _ in qa))
                     per_algo: List[Dict[int, Any]] = []
                     for a, m in zip(algos, models):
-                        per_algo.append(dict(a.batch_predict(m, indexed)))
+                        per_algo.append(dict(_eval_batch_predict(
+                            a, m, indexed)))
                     qpa = []
                     for i, (q, actual) in enumerate(qa):
                         predictions = [pa[i] for pa in per_algo]
@@ -201,6 +202,53 @@ class Engine:
                                     actual))
                     results[ci].append((eval_info, qpa))
         return results
+
+
+def _eval_chunk_size(default: int = 1024) -> int:
+    """``PIO_EVAL_BATCH``: queries per eval ``batch_predict`` dispatch
+    (0 disables chunking — one monolithic batch, the pre-ISSUE-7
+    behavior)."""
+    import os
+
+    try:
+        return int(os.environ.get("PIO_EVAL_BATCH", str(default)))
+    except ValueError:
+        return default
+
+
+def _eval_batch_predict(algo: Algorithm, model: Any,
+                        indexed: Sequence[Tuple[int, Any]]):
+    """Stream an eval fold's queries through the shared input-staging
+    path (ISSUE 7 satellite).
+
+    ``pio eval`` used to hand ``batch_predict`` the WHOLE fold in one
+    inline call — its own input path, with an unbounded [B, N] score
+    block for big folds.  Now the fold streams in ``PIO_EVAL_BATCH``
+    chunks through :class:`~predictionio_tpu.data.prefetch.
+    DevicePrefetcher` — the same staging machinery (lifecycle, queue
+    gauges, prep-thread exception propagation) the train loops ride.
+    The real win here is the bounded peak memory; the fold's queries are
+    already materialized before prediction and each ``batch_predict``
+    stages + dispatches internally, so the prep thread only slices —
+    there is no train-style H2D overlap to claim.  Per-query results
+    are unchanged (each chunk's padded batch covers every member
+    query's ``num``).
+    """
+    chunk = _eval_chunk_size()
+    if chunk <= 0 or len(indexed) <= chunk:
+        yield from algo.batch_predict(model, list(indexed))
+        return
+    from predictionio_tpu.data.prefetch import DevicePrefetcher
+
+    def chunks():
+        for start in range(0, len(indexed), chunk):
+            yield list(indexed[start:start + chunk])
+
+    with DevicePrefetcher(chunks(), lambda c: c,
+                          put_fn=lambda c: c,
+                          count_fn=len) as pf:
+        for staged in pf:
+            yield from algo.batch_predict(model, staged.args)
 
 
 @dataclasses.dataclass

@@ -22,6 +22,17 @@ loop:
   already covers are consumed from the source for determinism (the
   per-epoch shuffles must advance identically) but skipped *before* any
   prep/transfer work is spent on them;
+- **superbatch staging** (``fuse_steps`` K / a shared
+  :class:`~predictionio_tpu.data.fusion.FusionPlan`): K consecutive
+  prepped batches are stacked along a new leading axis and transferred
+  as ONE superbatch (``fused_put_fn``), feeding the models' K-step fused
+  ``lax.scan`` dispatch — the ISSUE-7 attack on the per-step
+  dispatch/sync cadence.  ``batch_scale`` M additionally concatenates M
+  prepped batches per scan slot (opt-in batch autoscaling).  A stream
+  ending mid-window flushes complete slots singly and leftovers at their
+  base shape; a resume landing mid-window (``skip_steps`` not on a K·M
+  boundary) replays the remainder unfused so windows stay aligned to the
+  absolute boundaries an uninterrupted run would use;
 - **clean shutdown + exception propagation**: errors raised by the
   source, ``prep_fn`` or the transfer surface in the consuming thread at
   the next ``next()``; ``close()`` (or leaving the ``with`` block — also
@@ -42,7 +53,9 @@ import queue
 import threading
 import time
 import weakref
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+from predictionio_tpu.data.fusion import FusionPlan
 
 __all__ = ["DevicePrefetcher", "PrefetchedBatch", "prefetch_depth"]
 
@@ -82,17 +95,26 @@ def prefetch_depth(default: int = DEFAULT_DEPTH) -> int:
 
 
 class PrefetchedBatch:
-    """One staged batch: device args + the overlap-window bookkeeping."""
+    """One staged batch: device args + the overlap-window bookkeeping.
 
-    __slots__ = ("step", "args", "examples", "h2d_ms", "staged_s")
+    A fused superbatch (``k > 1``) carries ``k`` scan slots stacked on a
+    new leading axis; ``steps`` counts the raw source batches consumed
+    (``k`` · batch_scale), so ``step`` — the LAST raw batch number — and
+    ``step - steps + 1`` bound the window."""
+
+    __slots__ = ("step", "args", "examples", "h2d_ms", "staged_s",
+                 "steps", "k")
 
     def __init__(self, step: int, args: Any, examples: int,
-                 h2d_ms: float, staged_s: float):
+                 h2d_ms: float, staged_s: float,
+                 steps: int = 1, k: int = 1):
         self.step = step          # 1-based global batch number (post-skip)
         self.args = args          # device arrays, ready to dispatch
         self.examples = examples  # real (pre-padding) examples
         self.h2d_ms = h2d_ms      # prep + transfer time on the prep thread
         self.staged_s = staged_s  # wall clock when staging finished
+        self.steps = steps        # raw source batches in this dispatch
+        self.k = k                # scan slots (fused depth; 1 = unfused)
 
 
 class _Done:
@@ -122,6 +144,13 @@ class DevicePrefetcher:
     transfer proceeds while the device executes the previous step, which
     is the point.  ``count_fn(raw_batch)`` reports the real example count
     before padding (default ``len(batch[0])``).
+
+    ``fuse_steps`` / ``batch_scale`` (or a live ``fuse_plan`` the
+    autotuner retargets between windows) turn on superbatch staging:
+    each window consumes K·M prepped batches, concatenates M per scan
+    slot, stacks the K slots on a new leading axis and transfers the
+    result via ``fused_put_fn`` (default: ``put_fn``) — sharded models
+    pass a fused put applying the leading-axis-aware ``NamedSharding``.
     """
 
     def __init__(
@@ -130,8 +159,12 @@ class DevicePrefetcher:
         prep_fn: Callable[[Any], Any],
         *,
         put_fn: Optional[Callable[[Any], Any]] = None,
+        fused_put_fn: Optional[Callable[[Any], Any]] = None,
         depth: Optional[int] = None,
         skip_steps: int = 0,
+        fuse_steps: int = 1,
+        batch_scale: int = 1,
+        fuse_plan: Optional[FusionPlan] = None,
         count_fn: Optional[Callable[[Any], int]] = None,
         clock: Callable[[], float] = time.perf_counter,
         wall_clock: Callable[[], float] = time.time,
@@ -142,9 +175,21 @@ class DevicePrefetcher:
         self._source = source
         self._prep_fn = prep_fn
         self._put_fn = put_fn if put_fn is not None else _default_put
+        self._fused_put_fn = fused_put_fn if fused_put_fn is not None \
+            else self._put_fn
         self._count_fn = count_fn if count_fn is not None \
             else (lambda batch: len(batch[0]))
         self._skip = max(int(skip_steps), 0)
+        self._plan = fuse_plan if fuse_plan is not None \
+            else FusionPlan(fuse_steps, batch_scale)
+        # K-aware resume: a restore landing mid-window replays the
+        # remainder unfused so fused windows stay aligned to the absolute
+        # K·M boundaries an uninterrupted run would dispatch (and the
+        # divergence-rollback target — always a window boundary — stays
+        # reachable by the same grouping).
+        w = self._plan.window_batches
+        self._realign = (w - self._skip % w) % w if (self._skip and w > 1) \
+            else 0
         self._clock = clock
         self._wall_clock = wall_clock
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -178,6 +223,9 @@ class DevicePrefetcher:
         it = iter(self._source)
         try:
             step = 0
+            realign = self._realign
+            window: List[Tuple[Any, int, float, int]] = []
+            km = (1, 1)
             while not self._stop.is_set():
                 try:
                     raw = next(it)
@@ -188,11 +236,43 @@ class DevicePrefetcher:
                     continue  # resume fast-forward: no prep, no transfer
                 t0 = self._clock()
                 examples = int(self._count_fn(raw))
-                staged = self._put_fn(self._prep_fn(raw))
-                h2d_ms = (self._clock() - t0) * 1e3
-                if not self._offer(PrefetchedBatch(
-                        step, staged, examples, h2d_ms, self._wall_clock())):
-                    return  # closed while waiting for queue space
+                prepped = self._prep_fn(raw)
+                prep_ms = (self._clock() - t0) * 1e3
+                if realign > 0:
+                    # Mid-window resume: replay to the next absolute
+                    # window boundary at the base (unfused) shape.
+                    realign -= 1
+                    if not self._emit_slot([(prepped, examples, prep_ms,
+                                             step)]):
+                        return
+                    continue
+                if not window:
+                    # Plan snapshot per window: the autotuner retargets
+                    # between windows, never inside one.
+                    km = self._plan.get()
+                if km[0] * km[1] <= 1:
+                    if not self._emit_slot([(prepped, examples, prep_ms,
+                                             step)]):
+                        return
+                    continue
+                window.append((prepped, examples, prep_ms, step))
+                if len(window) < km[0] * km[1]:
+                    continue
+                if not self._emit_window(window, *km):
+                    return
+                window = []
+            # End of stream mid-window: flush complete slots at their
+            # slot shape, leftover raw batches at the base shape —
+            # every compiled program involved already exists.
+            if not self._stop.is_set() and window:
+                k, m = km
+                while len(window) >= m and m > 1:
+                    if not self._emit_slot(window[:m]):
+                        return
+                    window = window[m:]
+                for entry in window:
+                    if not self._emit_slot([entry]):
+                        return
         except BaseException as e:  # noqa: BLE001 — must reach the consumer
             self._exc = e
         finally:
@@ -226,6 +306,37 @@ class DevicePrefetcher:
                 if self._depth_gauge is not None:
                     self._depth_gauge.set(staged, model=self._model)
             return True
+
+    def _emit_slot(self, entries: List[Tuple[Any, int, float, int]]) -> bool:
+        """Stage one optimizer step's batch: a single prepped batch, or
+        ``batch_scale`` prepped batches concatenated (both ride
+        ``put_fn`` — no leading scan axis)."""
+        t0 = self._clock()
+        arrays = entries[0][0] if len(entries) == 1 \
+            else _tree_concat([e[0] for e in entries])
+        staged = self._put_fn(arrays)
+        h2d_ms = sum(e[2] for e in entries) + (self._clock() - t0) * 1e3
+        return self._offer(PrefetchedBatch(
+            entries[-1][3], staged, sum(e[1] for e in entries), h2d_ms,
+            self._wall_clock(), steps=len(entries), k=1))
+
+    def _emit_window(self, window: List[Tuple[Any, int, float, int]],
+                     k: int, m: int) -> bool:
+        """Stage one fused superbatch: K slots (each M prepped batches
+        concatenated) stacked on a new leading axis, transferred via
+        ``fused_put_fn`` in one go."""
+        if k <= 1:
+            return self._emit_slot(window)
+        t0 = self._clock()
+        slots = [window[i * m:(i + 1) * m] for i in range(k)]
+        arrays = _tree_stack([
+            s[0][0] if m == 1 else _tree_concat([e[0] for e in s])
+            for s in slots])
+        staged = self._fused_put_fn(arrays)
+        h2d_ms = sum(e[2] for e in window) + (self._clock() - t0) * 1e3
+        return self._offer(PrefetchedBatch(
+            window[-1][3], staged, sum(e[1] for e in window), h2d_ms,
+            self._wall_clock(), steps=len(window), k=k))
 
     # -- consumer ------------------------------------------------------------
 
@@ -304,3 +415,28 @@ def _default_put(arrays: Any) -> Any:
     import jax
 
     return jax.device_put(arrays)
+
+
+def _tree_stack(items: List[Any]) -> Any:
+    """Stack prepped batches leaf-wise along a NEW leading axis (the scan
+    axis of a fused superbatch).  Batches are tuples/lists of arrays by
+    the prep convention; a bare array stacks directly."""
+    import numpy as np
+
+    if isinstance(items[0], (tuple, list)):
+        return type(items[0])(
+            np.stack([it[j] for it in items])
+            for j in range(len(items[0])))
+    return np.stack(items)
+
+
+def _tree_concat(items: List[Any]) -> Any:
+    """Concatenate prepped batches leaf-wise along the batch axis (the
+    batch-autoscale widening)."""
+    import numpy as np
+
+    if isinstance(items[0], (tuple, list)):
+        return type(items[0])(
+            np.concatenate([it[j] for it in items])
+            for j in range(len(items[0])))
+    return np.concatenate(items)

@@ -41,8 +41,9 @@ from predictionio_tpu.obs.runtime import get_compile_tracker
 from predictionio_tpu.parallel.compat import shard_map
 from predictionio_tpu.parallel.mesh import AXIS_EXPERT, put_sharded
 
-__all__ = ["DLRMConfig", "DLRMState", "init_state", "train_step", "train",
-           "predict_proba", "sharded_embedding_lookup"]
+__all__ = ["DLRMConfig", "DLRMState", "init_state", "train_step",
+           "train_steps_fused", "train", "predict_proba",
+           "sharded_embedding_lookup"]
 
 
 @dataclasses.dataclass
@@ -224,14 +225,10 @@ class _StepKey:
         return isinstance(other, _StepKey) and self._key == other._key
 
 
-# Batch tensors donated alongside the carried state (see two_tower): the
-# prefetched pipeline stages fresh buffers per step, so donation bounds
-# steady-state device memory at (prefetch depth + 1) batches.  CPU warns
-# the donation was unusable — expected there (pyproject filters it for
-# the test suite; where donation is real the warning stays audible).
-@functools.partial(jax.jit, static_argnames=("key",),
-                   donate_argnums=(0, 1, 2, 3, 4))
-def _train_step_impl(state_tuple, dense, cat, labels, weights, key: _StepKey):
+def _step_math(state_tuple, dense, cat, labels, weights, key: _StepKey):
+    """One optimizer step's pure math — shared VERBATIM by the per-step
+    jit and the K-fused ``lax.scan`` body so fused training is the same
+    traced computation (tests pin K=1 vs K>1 bitwise on CPU)."""
     params, opt_state, step = state_tuple
     loss, grads = jax.value_and_grad(_loss)(params, dense, cat, labels,
                                             weights, key.mesh)
@@ -240,10 +237,38 @@ def _train_step_impl(state_tuple, dense, cat, labels, weights, key: _StepKey):
     return (params, opt_state, step + 1), loss
 
 
-# Compile tracking (obs.runtime): see two_tower — bench.py keeps the raw
-# _train_step_impl for its fused-loop harness.
+# Batch tensors donated alongside the carried state (see two_tower): the
+# prefetched pipeline stages fresh buffers per step, so donation bounds
+# steady-state device memory at (prefetch depth + 1) batches.  CPU warns
+# the donation was unusable — expected there (pyproject filters it for
+# the test suite; where donation is real the warning stays audible).
+_train_step_impl = functools.partial(
+    jax.jit, static_argnames=("key",), donate_argnums=(0, 1, 2, 3, 4))(
+        _step_math)
+
+
+# K-step fused dispatch (ISSUE 7, see two_tower): ONE lax.scan program
+# runs K optimizer steps over a K-stacked superbatch, donating state and
+# the whole superbatch; returns the per-step loss vector [K] the
+# divergence guard checks at the fusion boundary.
+@functools.partial(jax.jit, static_argnames=("key",),
+                   donate_argnums=(0, 1, 2, 3, 4))
+def _fused_steps_impl(state_tuple, dense, cat, labels, weights,
+                      key: _StepKey):
+    def body(carry, batch):
+        d, c, y, w = batch
+        return _step_math(carry, d, c, y, w, key)
+
+    return jax.lax.scan(body, state_tuple, (dense, cat, labels, weights))
+
+
+# Compile tracking (obs.runtime): see two_tower — the fused entry point
+# tracks under its own name so fusion-depth changes read as named
+# compiles, not mystery churn.
 _tracked_train_step = get_compile_tracker().wrap(
     "dlrm.train_step", _train_step_impl)
+_tracked_fused_steps = get_compile_tracker().wrap(
+    "dlrm.train_steps_fused", _fused_steps_impl)
 
 
 def train_step(state: DLRMState, dense, cat, labels, weights,
@@ -258,6 +283,24 @@ def train_step(state: DLRMState, dense, cat, labels, weights,
     return DLRMState(params=p, opt_state=o, step=s), loss
 
 
+def train_steps_fused(state: DLRMState, dense, cat, labels, weights,
+                      cfg: DLRMConfig, mesh: Optional[Mesh] = None):
+    """K fused optimizer steps in ONE XLA dispatch.
+
+    Batch tensors carry a leading scan axis ([K, B, ...], staged by the
+    prefetcher's superbatch assembly); state and the whole superbatch
+    are donated.  Returns the carried state and the per-step loss vector
+    [K].  The resulting model state is bitwise-equal to K sequential
+    :func:`train_step` calls on the same batches (test-pinned on CPU;
+    the observability loss scalars may sit 1 ulp off standalone
+    dispatches — XLA fuses a rolled scan body's scalar output path
+    differently)."""
+    (p, o, s), losses = _tracked_fused_steps(
+        (state.params, state.opt_state, state.step),
+        dense, cat, labels, weights, _StepKey(cfg, mesh))
+    return DLRMState(params=p, opt_state=o, step=s), losses
+
+
 def train(
     dense: np.ndarray,      # [N, n_dense] float
     cat: np.ndarray,        # [N, F] int — PER-FIELD indices (offsets applied here)
@@ -268,6 +311,7 @@ def train(
     checkpoint_dir=None,
     save_every: int = 0,
     data_source: str = "auto",
+    fuse_steps=None,
 ) -> DLRMState:
     """Minibatch CTR training.
 
@@ -283,6 +327,12 @@ def train(
     last-good checkpoint (bounded, then ``TrainDiverged``), SIGTERM
     preemption (``TrainPreempted`` after a final checkpoint), and the
     ``PIO_STEP_TIMEOUT_S`` step watchdog.
+
+    ``fuse_steps`` mirrors two_tower.train: K optimizer steps fused into
+    one ``lax.scan`` dispatch (bitwise-equal to K=1), ``"auto"`` grows
+    depth until the HBM headroom guardrail pushes back; supervision
+    moves to the fusion boundary (scaled watchdog deadline, per-step
+    loss-vector divergence check, boundary-aligned checkpoints).
     """
     from predictionio_tpu.resilience.supervision import (
         DivergenceGuard,
@@ -300,7 +350,8 @@ def train(
             return _train_attempt(dense, cat, labels, cfg, mesh,
                                   checkpoint_dir=checkpoint_dir,
                                   save_every=save_every,
-                                  data_source=data_source, guard=guard)
+                                  data_source=data_source, guard=guard,
+                                  fuse_steps=fuse_steps)
         except RollbackRequested:
             continue  # re-enter: restore_step fast-forwards to last-good
 
@@ -316,6 +367,7 @@ def _train_attempt(
     save_every: int,
     data_source: str,
     guard,
+    fuse_steps=None,
 ) -> DLRMState:
     from predictionio_tpu.resilience.supervision import (
         StepWatchdog,
@@ -379,6 +431,15 @@ def _train_attempt(
     # Overlapped input pipeline (ISSUE 5 / data/prefetch.py): padding +
     # dtype conversion + H2D run on a background prep thread so batch
     # N+1's transfer rides under batch N's device step (see two_tower).
+    # K-step fusion (ISSUE 7 / data/fusion.py): superbatch staging + ONE
+    # lax.scan dispatch per window, supervision at the window boundary.
+    from predictionio_tpu.data.fusion import (
+        FusionAutotuner,
+        FusionPlan,
+        crossed_save_point,
+        fuse_steps_config,
+        slot_steps,
+    )
     from predictionio_tpu.data.prefetch import DevicePrefetcher
     from predictionio_tpu.obs import PipelineProbe
 
@@ -400,36 +461,80 @@ def _train_attempt(
         )
 
     put = None
+    fused_put = None
     if sh is not None:
         def put(arrays):
             return tuple(put_sharded(a, mesh, sh) for a in arrays)
 
+        # Superbatch staging: batch axis moves to dim 1 under the scan
+        # axis, so shard dim 1 and replicate the leading axis.
+        fused_sh = NamedSharding(mesh, P(None, AXIS_EXPERT))
+
+        def fused_put(arrays):
+            return tuple(put_sharded(a, mesh, fused_sh) for a in arrays)
+
+    k0, auto = fuse_steps_config(fuse_steps)
+    plan = FusionPlan(k0)
+    tuner = FusionAutotuner("dlrm", plan) if auto else None
+
     probe = PipelineProbe("dlrm")
     global_step = start_step
-    loss = None
+    pending = None  # (losses, slot steps) of the in-flight dispatch
+    in_flight = 0  # raw steps covered by the in-flight dispatch
     try:
         with DevicePrefetcher(
                 feeder_epochs() if use_feeder else numpy_epochs(),
-                prep, put_fn=put, skip_steps=start_step,
+                prep, put_fn=put, fused_put_fn=fused_put,
+                skip_steps=start_step, fuse_plan=plan,
                 model="dlrm") as pf:
             for batch in probe.iter_prefetched(pf):
                 global_step = batch.step
-                watchdog.arm(global_step)
-                probe.sync()  # wait on step N-1: its state feeds step N
-                if loss is not None:
-                    guard.check(loss, global_step - 1)
-                state, loss = train_step(state, *batch.args, cfg, mesh)
-                probe.dispatched(state, examples=batch.examples)
+                # Deadline covers the LONGER of the in-flight dispatch
+                # (the sync below blocks on dispatch N-1 — possibly a
+                # deeper window than this batch, e.g. a K=1 tail flush
+                # behind a K=32 window) and this batch's own dispatch.
+                watchdog.arm(global_step,
+                             scale=max(batch.steps, in_flight))
+                probe.sync()  # wait on dispatch N-1: its state feeds N
+                if pending is not None:
+                    # Dispatch N-1's losses materialized with the sync
+                    # above — every slot checked at the fusion boundary.
+                    guard.check_vector(*pending)
+                if batch.k > 1:
+                    state, losses = train_steps_fused(state, *batch.args,
+                                                      cfg, mesh)
+                else:
+                    state, losses = train_step(state, *batch.args, cfg,
+                                               mesh)
+                pending = (losses, slot_steps(batch))
+                in_flight = batch.steps
+                # Sync target includes the losses: the next boundary's
+                # divergence check reads them materialized, and the wait
+                # bills to device_wait where it belongs.
+                probe.dispatched((state, losses), examples=batch.examples,
+                                 steps=batch.steps)
                 saved = False
-                if ckpt.enabled and global_step % ckpt.save_every == 0:
-                    # Fresh watchdog deadline: the forced loss check
-                    # blocks on the device and a hang here must fire too.
-                    watchdog.arm(global_step)
-                    guard.check(loss, global_step)  # never save a NaN state
-                    saved = ckpt.maybe_save(
-                        global_step,
-                        (state.params, state.opt_state, state.step))
+                if ckpt.enabled and crossed_save_point(
+                        global_step, batch.steps, ckpt.save_every):
+                    # Fresh watchdog deadline: the forced loss-vector
+                    # check blocks on the device and a hang here must
+                    # fire too.  Checkpoints land on fusion boundaries —
+                    # never a NaN state, never mid-window.
+                    watchdog.arm(global_step, scale=batch.steps)
+                    guard.check_vector(*pending)
+                    if global_step % ckpt.save_every == 0:
+                        saved = ckpt.maybe_save(
+                            global_step,
+                            (state.params, state.opt_state, state.step))
+                    else:
+                        # Window boundary just past the cadence point.
+                        ckpt.save(global_step,
+                                  (state.params, state.opt_state,
+                                   state.step))
+                        saved = True
                 watchdog.disarm()
+                if tuner is not None:
+                    tuner.on_window()
                 if preemption_requested():
                     if ckpt.enabled and not saved:
                         ckpt.save(global_step,
@@ -438,8 +543,8 @@ def _train_attempt(
                     ckpt.flush()
                     raise TrainPreempted("dlrm", global_step, ckpt.enabled)
         probe.finish()
-        if loss is not None:
-            guard.check(loss, global_step)
+        if pending is not None:
+            guard.check_vector(*pending)
         guard.check_params(state.params, global_step)
         ckpt.complete()
     finally:

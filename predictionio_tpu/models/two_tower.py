@@ -34,7 +34,8 @@ from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, put_sharded
 
 __all__ = ["TwoTowerConfig", "TwoTowerState", "init_state", "train_step",
-           "train", "encode_users", "encode_items", "retrieve"]
+           "train_steps_fused", "train", "encode_users", "encode_items",
+           "retrieve"]
 
 
 @dataclasses.dataclass
@@ -159,6 +160,18 @@ def _loss(params: Dict, user_ids, item_ids, weights, temperature: float):
     return jnp.sum(losses * weights) / jnp.maximum(jnp.sum(weights), 1.0)
 
 
+def _step_math(state: Tuple, user_ids, item_ids, weights, cfg) -> Tuple:
+    """One optimizer step's pure math — shared VERBATIM by the per-step
+    jit and the K-fused ``lax.scan`` body so fused training is the same
+    traced computation (tests pin K=1 vs K>1 bitwise on CPU)."""
+    params, opt_state, step = state
+    loss, grads = jax.value_and_grad(_loss)(params, user_ids, item_ids,
+                                            weights, cfg.temperature)
+    updates, opt_state = _tx(cfg).update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return (params, opt_state, step + 1), loss
+
+
 # Batch tensors are donated along with the carried state: each step
 # consumes its staged batch exactly once (data/prefetch.py creates fresh
 # device buffers per step), so donation lets the allocator reclaim the
@@ -169,23 +182,37 @@ def _loss(params: Dict, user_ids, item_ids, weights, temperature: float):
 # expected there (pyproject filters it for the CPU test suite; anywhere
 # donation is real the warning stays audible — it would mean the memory
 # bound above is not holding).
+_train_step_impl = functools.partial(
+    jax.jit, static_argnames=("cfg",), donate_argnums=(0, 1, 2, 3))(
+        _step_math)
+
+
+# K-step fused dispatch (ISSUE 7): ONE XLA program runs K optimizer
+# steps via lax.scan over a K-stacked superbatch — the per-step
+# dispatch/sync cadence (BENCH_r06: ~99% of the residual pipeline gap
+# is device_wait) is paid once per K steps.  The whole superbatch is
+# donated like the single-step batch.  Returns the carried state and
+# the per-step loss vector [K] — the divergence guard checks every slot
+# at the fusion boundary.
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnums=(0, 1, 2, 3))
-def _train_step_impl(state: Tuple, user_ids, item_ids, weights, cfg) -> Tuple:
-    params, opt_state, step = state
-    loss, grads = jax.value_and_grad(_loss)(params, user_ids, item_ids,
-                                            weights, cfg.temperature)
-    updates, opt_state = _tx(cfg).update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
-    return (params, opt_state, step + 1), loss
+def _fused_steps_impl(state: Tuple, user_ids, item_ids, weights,
+                      cfg) -> Tuple:
+    def body(carry, batch):
+        u, i, w = batch
+        return _step_math(carry, u, i, w, cfg)
+
+    return jax.lax.scan(body, state, (user_ids, item_ids, weights))
 
 
 # Compile tracking (obs.runtime): cache growth across a call = an XLA
 # compilation, exported as pio_xla_compile_total{fn=...} + shape-churn
-# warnings.  bench.py keeps importing the raw _train_step_impl (it nests
-# the step inside its own fused jit, where per-call tracking is noise).
+# warnings.  The fused entry point tracks under its own name, so a
+# fusion-depth change shows up as a named compile, not mystery churn.
 _tracked_train_step = get_compile_tracker().wrap(
     "two_tower.train_step", _train_step_impl)
+_tracked_fused_steps = get_compile_tracker().wrap(
+    "two_tower.train_steps_fused", _fused_steps_impl)
 
 
 # dataclasses aren't pytrees; tuple in/out keeps jit donation simple.
@@ -200,6 +227,25 @@ def train_step(state: TwoTowerState, user_ids, item_ids, weights,
         (state.params, state.opt_state, state.step),
         user_ids, item_ids, weights, hcfg)
     return TwoTowerState(params=p, opt_state=o, step=s), loss
+
+
+def train_steps_fused(state: TwoTowerState, user_ids, item_ids, weights,
+                      cfg: TwoTowerConfig) -> Tuple[TwoTowerState, jax.Array]:
+    """K fused optimizer steps in ONE XLA dispatch.
+
+    The batch tensors carry a leading scan axis ([K, B] / [K, B, ...],
+    staged by the prefetcher's superbatch assembly); state and the whole
+    superbatch are donated.  Returns the carried state and the per-step
+    loss vector [K].  The resulting model state is bitwise-equal to K
+    sequential :func:`train_step` calls on the same batches (test-pinned
+    on CPU; the observability loss scalars may sit 1 ulp off standalone
+    dispatches — XLA fuses a rolled scan body's scalar output path
+    differently)."""
+    hcfg = _HashableConfig(cfg)
+    (p, o, s), losses = _tracked_fused_steps(
+        (state.params, state.opt_state, state.step),
+        user_ids, item_ids, weights, hcfg)
+    return TwoTowerState(params=p, opt_state=o, step=s), losses
 
 
 class _HashableConfig:
@@ -229,6 +275,7 @@ def train(
     checkpoint_dir=None,
     save_every: int = 0,
     data_source: str = "auto",
+    fuse_steps=None,
 ) -> TwoTowerState:
     """Minibatch training loop over interaction pairs.
 
@@ -252,6 +299,16 @@ def train(
     SIGTERM preemption checkpoints and raises ``TrainPreempted``; with
     ``PIO_STEP_TIMEOUT_S`` set, a hung device step fires the watchdog
     instead of blocking forever.
+
+    ``fuse_steps`` (default: env ``PIO_FUSE_STEPS``, else 1): fuse K
+    optimizer steps into one XLA dispatch (``lax.scan`` over a K-stacked
+    superbatch the prefetcher assembles) — bitwise-equal to K=1,
+    dispatch/sync paid once per K steps.  ``"auto"`` starts at 1 and
+    grows depth between rounds until the HBM headroom guardrail pushes
+    back (data/fusion.py).  Supervision moves to the fusion boundary:
+    the watchdog deadline scales by K, the divergence guard checks the
+    per-step loss vector, and checkpoints land on window boundaries so a
+    rollback target never splits a window.
     """
     from predictionio_tpu.resilience.supervision import (
         DivergenceGuard,
@@ -269,7 +326,8 @@ def train(
             return _train_attempt(user_ids, item_ids, cfg, mesh, weights,
                                   checkpoint_dir=checkpoint_dir,
                                   save_every=save_every,
-                                  data_source=data_source, guard=guard)
+                                  data_source=data_source, guard=guard,
+                                  fuse_steps=fuse_steps)
         except RollbackRequested:
             continue  # re-enter: restore_step fast-forwards to last-good
 
@@ -285,6 +343,7 @@ def _train_attempt(
     save_every: int,
     data_source: str,
     guard,
+    fuse_steps=None,
 ) -> TwoTowerState:
     from predictionio_tpu.resilience.supervision import (
         StepWatchdog,
@@ -341,6 +400,17 @@ def _train_attempt(
     # background prep thread, double-buffered, so batch N+1's H2D rides
     # under batch N's device step.  The probe attributes the staging to
     # the overlap window; only the queue wait stays on the step loop.
+    # K-step fusion (ISSUE 7 / data/fusion.py): the prefetcher stacks K
+    # prepped batches into one superbatch and the loop dispatches ONE
+    # lax.scan program per window — supervision sits at the window
+    # boundary.
+    from predictionio_tpu.data.fusion import (
+        FusionAutotuner,
+        FusionPlan,
+        crossed_save_point,
+        fuse_steps_config,
+        slot_steps,
+    )
     from predictionio_tpu.data.prefetch import DevicePrefetcher
     from predictionio_tpu.obs import PipelineProbe
 
@@ -359,42 +429,85 @@ def _train_attempt(
         )
 
     put = None
+    fused_put = None
     if batch_sharding is not None:
         def put(arrays):
             return tuple(put_sharded(a, mesh, batch_sharding)
                          for a in arrays)
 
+        # Superbatches carry a leading scan axis: the batch axis moves
+        # to dim 1, so the fused staging shards dim 1 and replicates the
+        # scan axis.
+        fused_sharding = NamedSharding(mesh, P(None, AXIS_DATA))
+
+        def fused_put(arrays):
+            return tuple(put_sharded(a, mesh, fused_sharding)
+                         for a in arrays)
+
+    k0, auto = fuse_steps_config(fuse_steps)
+    plan = FusionPlan(k0)
+    tuner = FusionAutotuner("two_tower", plan) if auto else None
+
     probe = PipelineProbe("two_tower")
     global_step = start_step
-    loss = None
+    pending = None  # (losses, slot steps) of the in-flight dispatch
+    in_flight = 0  # raw steps covered by the in-flight dispatch
     try:
         with DevicePrefetcher(
                 feeder_epochs() if use_feeder else numpy_epochs(),
-                prep, put_fn=put, skip_steps=start_step,
+                prep, put_fn=put, fused_put_fn=fused_put,
+                skip_steps=start_step, fuse_plan=plan,
                 model="two_tower") as pf:
             for batch in probe.iter_prefetched(pf):
                 global_step = batch.step
-                watchdog.arm(global_step)
-                probe.sync()  # wait on step N-1: its state feeds step N
-                if loss is not None:
-                    # Step N-1's loss materialized with the sync above —
-                    # the finiteness check costs one float().
-                    guard.check(loss, global_step - 1)
-                state, loss = train_step(state, *batch.args, cfg)
-                probe.dispatched(state, examples=batch.examples)
+                # Deadline covers the LONGER of the in-flight dispatch
+                # (the sync below blocks on dispatch N-1 — possibly a
+                # deeper window than this batch, e.g. a K=1 tail flush
+                # behind a K=32 window) and this batch's own dispatch.
+                watchdog.arm(global_step,
+                             scale=max(batch.steps, in_flight))
+                probe.sync()  # wait on dispatch N-1: its state feeds N
+                if pending is not None:
+                    # Dispatch N-1's losses materialized with the sync
+                    # above — every slot of its window is checked at the
+                    # fusion boundary for one host read of K floats.
+                    guard.check_vector(*pending)
+                if batch.k > 1:
+                    state, losses = train_steps_fused(state, *batch.args,
+                                                      cfg)
+                else:
+                    state, losses = train_step(state, *batch.args, cfg)
+                pending = (losses, slot_steps(batch))
+                in_flight = batch.steps
+                # Sync target includes the losses: the next boundary's
+                # divergence check reads them materialized, and the wait
+                # bills to device_wait where it belongs.
+                probe.dispatched((state, losses), examples=batch.examples,
+                                 steps=batch.steps)
                 saved = False
-                if ckpt.enabled and global_step % ckpt.save_every == 0:
+                if ckpt.enabled and crossed_save_point(
+                        global_step, batch.steps, ckpt.save_every):
                     # Never checkpoint unvalidated state: force this
-                    # step's loss (rare — only at the save cadence) so a
-                    # rollback target is always finite.  Re-armed with a
-                    # fresh deadline first: this float() blocks on the
-                    # device, and a hang HERE must fire the watchdog too.
-                    watchdog.arm(global_step)
-                    guard.check(loss, global_step)
-                    saved = ckpt.maybe_save(
-                        global_step,
-                        (state.params, state.opt_state, state.step))
+                    # window's losses (rare — only at the save cadence)
+                    # so a rollback target is always finite AND always a
+                    # fusion boundary.  Re-armed with a fresh deadline
+                    # first: the materialization blocks on the device,
+                    # and a hang HERE must fire the watchdog too.
+                    watchdog.arm(global_step, scale=batch.steps)
+                    guard.check_vector(*pending)
+                    if global_step % ckpt.save_every == 0:
+                        saved = ckpt.maybe_save(
+                            global_step,
+                            (state.params, state.opt_state, state.step))
+                    else:
+                        # Window boundary just past the cadence point.
+                        ckpt.save(global_step,
+                                  (state.params, state.opt_state,
+                                   state.step))
+                        saved = True
                 watchdog.disarm()
+                if tuner is not None:
+                    tuner.on_window()
                 if preemption_requested():
                     if ckpt.enabled and not saved:
                         ckpt.save(global_step,
@@ -404,8 +517,8 @@ def _train_attempt(
                     raise TrainPreempted("two_tower", global_step,
                                          ckpt.enabled)
         probe.finish()
-        if loss is not None:
-            guard.check(loss, global_step)
+        if pending is not None:
+            guard.check_vector(*pending)
         guard.check_params(state.params, global_step)
         ckpt.complete()
     finally:

@@ -7,6 +7,12 @@ iteration's wall time into named, separately-plotted components:
 
 - ``host_wait``  — time blocked fetching the next batch (feeder / numpy)
 - ``h2d``        — time converting + transferring the batch to device
+- ``dispatch``   — time inside the step call itself: trace-cache lookup +
+  argument handling + enqueue.  On backends that dispatch donated
+  programs synchronously (CPU) the execution itself lands here — which
+  is exactly why the component exists: without it the step wall hides
+  between probe points and device_wait under-reports (it did, until
+  ISSUE 7)
 - ``device_wait``— time the HOST then stalls on the previous dispatched
   step (the device-bound residual)
 - ``device_step``— dispatch→ready duration of each step (the device-step
@@ -35,6 +41,7 @@ stays importable without an accelerator stack.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Iterable, Iterator, Optional
 
@@ -73,6 +80,23 @@ class _Timed:
         if self._cur is not None:
             self._cur[self._key] = ms
         return False
+
+
+def _sync_target(outputs: Any) -> Any:
+    """Normalize dispatched outputs for ``jax.block_until_ready``.
+
+    The model States (TwoTowerState/DLRMState) are plain dataclasses —
+    deliberately NOT pytrees — so passed raw they are opaque leaves and
+    ``block_until_ready`` silently skips their arrays, zeroing out the
+    device_wait attribution.  Walking dataclass fields (and containers)
+    down to real arrays makes the sync block on what the dispatch
+    actually produced."""
+    if dataclasses.is_dataclass(outputs) and not isinstance(outputs, type):
+        return [_sync_target(getattr(outputs, f.name))
+                for f in dataclasses.fields(outputs)]
+    if isinstance(outputs, (list, tuple)):
+        return [_sync_target(x) for x in outputs]
+    return outputs
 
 
 class PipelineProbe:
@@ -119,6 +143,11 @@ class PipelineProbe:
             "Background staging time overlapped under device compute "
             "(prefetched pipeline; not part of the step-loop wall).",
             labelnames)
+        self._dispatch = reg.histogram(
+            "pio_train_dispatch_ms",
+            "Time inside the step call (cache lookup + enqueue; on "
+            "synchronous-dispatch backends the execution itself).",
+            labelnames)
         self._device_wait = reg.histogram(
             "pio_train_device_wait_ms",
             "Host stall waiting on the previously dispatched device step.",
@@ -145,6 +174,10 @@ class PipelineProbe:
             "Training examples consumed (pre-padding).", labelnames)
         self._pending: Optional[Any] = None
         self._pending_t0 = 0.0
+        # Reference point for the dispatch interval: end of the last
+        # sync (or of the batch fetch when nothing was in flight) up to
+        # dispatched() — the step call's own wall.
+        self._dispatch_ref: Optional[float] = None
         # Current-iteration scratch + the dispatched-step snapshot: the
         # loop overwrites _cur with step N's host_wait/h2d while step N-1
         # is still in flight, so dispatched() freezes _cur into
@@ -171,6 +204,7 @@ class PipelineProbe:
             self._host_wait.observe(ms, **self._labels)
             self._last["host_wait"].set(ms, **self._labels)
             self._cur = {"host_wait": ms, "start_s": time.time() - ms / 1e3}
+            self._dispatch_ref = time.perf_counter()
             if on_batch is not None:
                 on_batch(batch)
             yield batch
@@ -208,6 +242,7 @@ class PipelineProbe:
         t0 = time.perf_counter()
         jax.block_until_ready(self._pending)
         t1 = time.perf_counter()
+        self._dispatch_ref = t1
         self._device_wait.observe((t1 - t0) * 1e3, **self._labels)
         self._last["device_wait"].set((t1 - t0) * 1e3, **self._labels)
         self._device_step.observe((t1 - self._pending_t0) * 1e3,
@@ -222,20 +257,36 @@ class PipelineProbe:
             h2d_overlap_ms=meta.get("h2d_overlap", 0.0),
             staged_s=meta.get("staged_s"),
             dispatch_s=meta.get("dispatch_s"),
+            dispatch_ms=meta.get("dispatch", 0.0),
             device_wait_ms=(t1 - t0) * 1e3,
             device_step_ms=(t1 - self._pending_t0) * 1e3,
-            examples=meta.get("examples", 0))
+            examples=meta.get("examples", 0),
+            fused_steps=meta.get("steps", 1))
         self._pending = None
         self._pending_meta = None
 
-    def dispatched(self, outputs: Any, examples: int = 0) -> None:
-        """Register a freshly dispatched step's outputs for the next sync."""
-        self._pending = outputs
+    def dispatched(self, outputs: Any, examples: int = 0,
+                   steps: int = 1) -> None:
+        """Register a freshly dispatched step's outputs for the next sync.
+
+        ``steps`` is the optimizer-step count this ONE dispatch covers (a
+        K-fused ``lax.scan`` window passes K): the steps counter advances
+        by it, and the timeline record carries it so the per-dispatch
+        wall is attributable to K steps downstream (attribute_gap)."""
+        self._pending = _sync_target(outputs)
         self._pending_t0 = time.perf_counter()
-        self._steps.inc(**self._labels)
+        if self._dispatch_ref is not None:
+            # The step call's own wall: everything between the last
+            # probe point (sync, or batch fetch) and here.
+            ms = (self._pending_t0 - self._dispatch_ref) * 1e3
+            self._dispatch.observe(ms, **self._labels)
+            self._cur["dispatch"] = ms
+            self._dispatch_ref = None
+        steps = max(int(steps), 1)
+        self._steps.inc(steps, **self._labels)
         if examples:
             self._examples.inc(examples, **self._labels)
-        self._step_no += 1
+        self._step_no += steps
         meta = dict(self._cur)
         meta.setdefault("start_s", time.time())
         # True dispatch wall time: the Chrome-trace export starts the
@@ -244,6 +295,7 @@ class PipelineProbe:
         meta["dispatch_s"] = time.time()
         meta["step"] = self._step_no
         meta["examples"] = examples
+        meta["steps"] = steps
         self._pending_meta = meta
         self._cur = {}
 

@@ -431,6 +431,32 @@ class DeviceMemorySampler:
         with self._lock:
             return dict(self._peaks)
 
+    def headroom_exceeded(self, fraction: Optional[float] = None) -> bool:
+        """Train-run PEAK ``bytes_in_use`` (since ``reset_peak``, folded
+        with one fresh sample) checked against the HBM headroom
+        guardrail: True when any device's peak crosses ``fraction``
+        (default ``PIO_HBM_WARN_FRACTION``) of its allocator
+        ``bytes_limit``.  The fusion/batch autotuner's probe — it
+        decides at round boundaries, i.e. in the trough BETWEEN windows
+        (and the host runs ahead of the device), so the instantaneous
+        sample routinely misses the mid-scan peak the background
+        sampler saw; deciding on the trough would grow straight past
+        the limit into an OOM.  Backends reporting no limit (CPU
+        live-array fallback) can never push back and return False."""
+        frac = self._warn_fraction() if fraction is None else float(fraction)
+        if frac <= 0:
+            return False
+        rows = self.sample_once()  # also folds this sample into _peaks
+        with self._lock:
+            peaks = dict(self._peaks)
+        for label, row in rows.items():
+            in_use = row.get("bytes_in_use")
+            limit = row.get("bytes_limit")
+            peak = max(in_use or 0.0, peaks.get(label, 0.0))
+            if peak and limit and peak > frac * limit:
+                return True
+        return False
+
     def reset_peak(self) -> None:
         """Start a fresh peak window (run_train calls this at run start)."""
         with self._lock:
@@ -489,12 +515,15 @@ class StepTimeline:
     (records, default 2048).
     """
 
-    PHASES = ("host_wait", "h2d", "h2d_overlap", "device_wait",
-              "device_step")
+    PHASES = ("host_wait", "h2d", "h2d_overlap", "dispatch",
+              "device_wait", "device_step")
     # host-lane phases whose sum approximates the iteration's wall time.
     # h2d_overlap is deliberately NOT here: prefetched staging runs under
     # device compute (data/prefetch.py) and costs the step loop nothing.
-    WALL_PHASES = ("host_wait", "h2d", "device_wait")
+    # dispatch IS here: the step call's own wall — on synchronous-
+    # dispatch backends (CPU with donated buffers) it carries the
+    # execution itself, and before ISSUE 7 it hid between probe points.
+    WALL_PHASES = ("host_wait", "h2d", "dispatch", "device_wait")
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
@@ -509,12 +538,14 @@ class StepTimeline:
 
     def record(self, model: str, *, host_wait_ms: float = 0.0,
                h2d_ms: float = 0.0, h2d_overlap_ms: float = 0.0,
+               dispatch_ms: float = 0.0,
                device_wait_ms: float = 0.0,
                device_step_ms: float = 0.0, examples: int = 0,
                start_s: Optional[float] = None,
                dispatch_s: Optional[float] = None,
                staged_s: Optional[float] = None,
-               step: Optional[int] = None) -> None:
+               step: Optional[int] = None,
+               fused_steps: int = 1) -> None:
         if start_s is None:
             start_s = time.time()
         rec = {
@@ -523,9 +554,15 @@ class StepTimeline:
             "hostWaitMs": round(float(host_wait_ms), 4),
             "h2dMs": round(float(h2d_ms), 4),
             "h2dOverlapMs": round(float(h2d_overlap_ms), 4),
+            "dispatchMs": round(float(dispatch_ms), 4),
             "deviceWaitMs": round(float(device_wait_ms), 4),
             "deviceStepMs": round(float(device_step_ms), 4),
             "examples": int(examples),
+            # Optimizer steps this ONE record (= one dispatch) covers: a
+            # K-fused lax.scan window writes K — the per-dispatch wall is
+            # attributable to K steps, and attribute_gap reads the mean
+            # fusion depth off the summary.
+            "fusedSteps": max(int(fused_steps), 1),
         }
         # True dispatch / staging-end wall clocks (when known): the
         # Chrome export draws the device and prefetch lanes from these
@@ -564,19 +601,27 @@ class StepTimeline:
                      if model is None or r["model"] == model]
         totals = {p: 0.0 for p in self.PHASES}
         examples = 0
+        steps = 0
         for r in items:
             totals["host_wait"] += r["hostWaitMs"]
             totals["h2d"] += r["h2dMs"]
             totals["h2d_overlap"] += r.get("h2dOverlapMs", 0.0)
+            totals["dispatch"] += r.get("dispatchMs", 0.0)
             totals["device_wait"] += r["deviceWaitMs"]
             totals["device_step"] += r["deviceStepMs"]
             examples += r["examples"]
+            steps += max(int(r.get("fusedSteps", 1)), 1)
         wall = sum(totals[p] for p in self.WALL_PHASES)
         shares = {p: (totals[p] / wall if wall > 0 else 0.0)
                   for p in self.WALL_PHASES}
         return {
             "model": model,
-            "steps": len(items),
+            # Optimizer steps vs dispatches: with K-step fusion one
+            # record covers K steps, so the pair exposes the mean
+            # fusion depth attribute_gap reports.
+            "steps": steps,
+            "dispatches": len(items),
+            "fuse_steps": round(steps / len(items), 2) if items else 0.0,
             "examples": examples,
             "phase_ms": {p: round(v, 3) for p, v in totals.items()},
             "phase_share": {p: round(v, 4) for p, v in shares.items()},
@@ -615,8 +660,9 @@ class StepTimeline:
             ts = r["startS"] * 1e6
             for key, name in (("hostWaitMs", "host_wait"),
                               ("h2dMs", "h2d"),
+                              ("dispatchMs", "dispatch"),
                               ("deviceWaitMs", "device_wait")):
-                dur = r[key] * 1e3
+                dur = r.get(key, 0.0) * 1e3
                 if dur <= 0:
                     continue
                 events.append({"name": name, "ph": "X", "pid": pid,

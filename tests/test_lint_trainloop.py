@@ -88,6 +88,88 @@ def test_required_files_must_define_train_attempt():
     assert "_train_attempt" in violations[0]
 
 
+def test_scan_body_host_sync_banned():
+    src = """
+import jax
+from jax import lax
+
+def _fused(state, xs):
+    def body(carry, x):
+        new = step(carry, x)
+        loss = float(new[1])                  # <- host sync in scan body
+        jax.device_get(new[0])                # <- and another
+        return new, loss
+    return lax.scan(body, state, xs)
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 2
+    assert any("float" in v for v in violations)
+    assert any("device_get" in v for v in violations)
+    assert all("scan body" in v for v in violations)
+
+
+def test_scan_body_block_until_ready_banned_via_jax_lax():
+    src = """
+import jax
+
+def _fused(state, xs):
+    def body(carry, x):
+        carry = step(carry, x)
+        carry[0].block_until_ready()          # <- host sync in scan body
+        return carry, carry[1]
+    return jax.lax.scan(body, state, xs)
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 1
+    assert "block_until_ready" in violations[0]
+
+
+def test_scan_body_without_syncs_is_clean():
+    src = """
+from jax import lax
+
+def _fused(state, xs):
+    def body(carry, x):
+        return step(carry, x)
+    return lax.scan(body, state, xs)
+"""
+    assert lint_trainloop.check_source(src, "model.py") == []
+
+
+def test_supervision_in_nested_function_flagged():
+    src = """
+def _train_attempt(data, cfg, guard, watchdog):
+    def prep(b):
+        guard.check(b, 0)                     # <- off the boundary
+        return b
+
+    with DevicePrefetcher(iter(data), prep) as pf:
+        for batch in pf:
+            watchdog.arm(batch.step, scale=batch.steps)
+            state, losses = step(state, *batch.args)
+            guard.check_vector(losses, [batch.step])
+            watchdog.disarm()
+"""
+    violations = lint_trainloop.check_source(src, "model.py")
+    assert len(violations) == 1
+    assert "nested function" in violations[0]
+    assert "guard.check" in violations[0]
+
+
+def test_required_loop_missing_boundary_supervision_flagged():
+    src = """
+def _train_attempt(data, cfg):
+    with DevicePrefetcher(iter(data), lambda b: b) as pf:
+        for batch in pf:
+            state = step(state, *batch.args)
+"""
+    violations = lint_trainloop.check_source(src, "two_tower.py",
+                                             require_prefetcher=True)
+    assert len(violations) == 2
+    assert any("watchdog.arm" in v for v in violations)
+    assert any("guard.check" in v for v in violations)
+
+
 def test_host_numpy_in_loops_is_fine():
     src = """
 import numpy as np

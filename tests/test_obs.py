@@ -793,6 +793,21 @@ class TestHbmHeadroomWarning:
         assert not [r for r in caplog.records
                     if "HBM headroom" in r.message]
 
+    def test_headroom_exceeded_latches_the_run_peak(self):
+        # The fusion autotuner probes BETWEEN windows — in the memory
+        # trough.  headroom_exceeded must answer from the run PEAK the
+        # sampler observed (here: a mid-window sample), not the
+        # instantaneous trough, or the tuner grows straight past the
+        # limit into an OOM.  reset_peak (a new train run) re-arms it.
+        stats = {"bytes_in_use": 950, "bytes_limit": 1000}
+        sampler = self._sampler(stats)
+        sampler.sample_once()  # mid-window: the peak
+        stats["bytes_in_use"] = 100  # trough at the round boundary
+        assert sampler.headroom_exceeded() is True
+        sampler.reset_peak()
+        assert sampler.headroom_exceeded() is False
+        assert sampler.headroom_exceeded(fraction=0.05) is True
+
 
 class TestAttributeGapCompare:
     OLD = {

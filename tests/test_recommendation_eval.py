@@ -64,6 +64,40 @@ def test_eval_sweep_end_to_end(pio_home):
     assert inst.status == "EVALCOMPLETED"
 
 
+def test_eval_chunked_prediction_matches_monolithic(pio_home, monkeypatch):
+    """ISSUE 7 satellite: the eval fold streams through DevicePrefetcher
+    in PIO_EVAL_BATCH chunks — per-query results must be identical to
+    the old one-monolithic-batch path."""
+    ctx = RuntimeContext.create(storage=get_storage())
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name="testapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(1)
+    for u in range(16):
+        for i in range(10):
+            if i % 2 == u % 2 and rng.random() < 0.5:
+                storage.get_events().insert(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": 4.0})), app_id)
+    ev = evaluation()
+    gen = default_params_generator("testapp", eval_k=2, ranks=(4,))
+    (engine_params,) = gen.engine_params_list
+
+    monkeypatch.setenv("PIO_EVAL_BATCH", "0")  # monolithic (pre-ISSUE-7)
+    mono = ev.engine.eval(ctx, engine_params)
+    monkeypatch.setenv("PIO_EVAL_BATCH", "3")  # tiny chunks, many windows
+    chunked = ev.engine.eval(ctx, engine_params)
+
+    assert len(mono) == len(chunked)
+    for (ei_m, qpa_m), (ei_c, qpa_c) in zip(mono, chunked):
+        assert len(qpa_m) == len(qpa_c)
+        for (qm, pm, am), (qc, pc, ac) in zip(qpa_m, qpa_c):
+            assert qm.user == qc.user and am == ac
+            assert [(s.item, s.score) for s in pm.itemScores] == \
+                [(s.item, s.score) for s in pc.itemScores]
+
+
 def test_eval_sweep_shares_data_pass(pio_home, monkeypatch):
     """3 candidates varying only algorithm params must read + prepare the
     fold data ONCE (round-2 verdict item 9)."""

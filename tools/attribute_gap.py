@@ -42,11 +42,25 @@ ATTACKS = {
                  "(the host cannot produce batches fast enough)",
     "h2d": "pinned buffers / double buffering "
            "(stage batch N+1 while step N runs)",
-    "device_wait": "step fusion or a larger batch size "
+    "dispatch": "step fusion (PIO_FUSE_STEPS / pio train --fuse-steps "
+                "auto) or a larger batch size (the step-call wall — on "
+                "synchronous-dispatch backends the execution itself — "
+                "dominates)",
+    "device_wait": "step fusion (PIO_FUSE_STEPS / pio train --fuse-steps "
+                   "auto) or a larger batch size "
                    "(the device step itself is the bottleneck)",
 }
 
-WALL_PHASES = ("host_wait", "h2d", "device_wait")
+# dispatch/device_wait with fusion ALREADY active (K>1): re-recommending
+# fusion would chase the component that is now mostly honest device
+# execution — the remaining levers are batch width and memory headroom.
+ATTACK_DEVICE_WAIT_FUSED = (
+    "batch-size growth (--batch-autoscale) after an HBM-headroom check "
+    "(pio_device_mem_peak_bytes vs bytes_limit) — fusion depth K>1 "
+    "already amortizes dispatch, the residual device time is mostly "
+    "honest execution")
+
+WALL_PHASES = ("host_wait", "h2d", "dispatch", "device_wait")
 
 
 def load_json(path: str) -> Dict[str, Any]:
@@ -162,19 +176,66 @@ def attribute(bench: Dict[str, Any],
             out[model] = None
             continue
         dominant = max(shares, key=lambda p: shares[p])
+        # Fusion depth: rounds predating ISSUE 7 carry no fuse_steps —
+        # one record was one step, K=1.
+        fuse = float(summary.get("fuse_steps") or 1.0)
+        attack = (ATTACK_DEVICE_WAIT_FUSED
+                  if dominant in ("device_wait", "dispatch") and fuse > 1
+                  else ATTACKS[dominant])
         out[model] = {
             "gap_pct": gap,
             "feeder_examples_per_sec": feeder,
             "pipeline_examples_per_sec": pipe,
             "device_examples_per_sec": dev,
             "steps": summary.get("steps"),
+            "dispatches": summary.get("dispatches"),
+            "fuse_steps": fuse,
             "phase_share": shares,
             "phase_ms": summary.get("phase_ms", {}),
             "dominant": dominant,
             "dominant_share": shares[dominant],
-            "attack": ATTACKS[dominant],
+            "attack": attack,
+            "residual": _residual(summary, dev),
         }
     return out
+
+
+def _residual(summary: Dict[str, Any], dev: Any) -> Optional[Dict[str, Any]]:
+    """Decompose the GAP itself, not the wall: subtract the estimated
+    pure device-execution time (examples / device ceiling) from
+    device_wait, leaving ``device_excess`` — the dispatch/sync overhead
+    step fusion attacks.  Pre-fusion rounds showed device_wait at ~99%
+    of the wall even when most of it was honest execution; this view
+    says how much of the residual is actually attackable."""
+    steps = summary.get("steps")
+    examples = summary.get("examples")
+    phase_ms = summary.get("phase_ms", {})
+    if not (isinstance(dev, (int, float)) and dev > 0 and steps
+            and examples):
+        return None
+    exec_ms_per_step = (examples / steps) / dev * 1e3
+    per_step = {p: float(phase_ms.get(p, 0.0)) / steps for p in WALL_PHASES}
+    comps = {
+        "host_wait": per_step["host_wait"],
+        "h2d": per_step["h2d"],
+        # dispatch + device_wait together hold the device-side wall (a
+        # synchronous-dispatch backend bills execution to the former, an
+        # async one to the latter); what exceeds the estimated pure
+        # execution is the attackable overhead.
+        "device_excess": max(per_step["dispatch"] + per_step["device_wait"]
+                             - exec_ms_per_step, 0.0),
+    }
+    total = sum(comps.values())
+    if total <= 0:
+        return None
+    dominant = max(comps, key=lambda p: comps[p])
+    return {
+        "exec_ms_per_step_est": round(exec_ms_per_step, 3),
+        "ms_per_step": {p: round(v, 3) for p, v in comps.items()},
+        "share": {p: round(v / total, 4) for p, v in comps.items()},
+        "dominant": dominant,
+        "dominant_share": round(comps[dominant] / total, 4),
+    }
 
 
 def _round_stats(bench: Dict[str, Any], model: str,
@@ -226,6 +287,8 @@ def compare(old_bench: Dict[str, Any],
             oa, na = o.get("attribution"), n.get("attribution")
             if oa and na:
                 entry["dominant_shift"] = (oa["dominant"], na["dominant"])
+                entry["fuse_steps_shift"] = (oa.get("fuse_steps", 1.0),
+                                             na.get("fuse_steps", 1.0))
         out[model] = entry
     return out
 
@@ -274,9 +337,23 @@ def render_compare(result: Dict[str, Any]) -> str:
                 f"  dominant component (new round): {na['dominant']} "
                 f"({na['dominant_share'] * 100:.1f}% of step wall; "
                 "old round has no timeline)")
+        if "fuse_steps_shift" in r:
+            ok_, nk = r["fuse_steps_shift"]
+            if ok_ != nk:
+                lines.append(
+                    f"  fusion depth: K={ok_:.0f} -> K={nk:.0f}")
+        if na and na.get("residual"):
+            lines.append("  residual per step (new round, vs device "
+                         "ceiling): " + _fmt_residual(na["residual"]))
         if na:
             lines.append(f"  next attack: {na['attack']}")
     return "\n".join(lines)
+
+
+def _fmt_residual(res: Dict[str, Any]) -> str:
+    return " | ".join(
+        f"{p} {res['share'][p] * 100:.1f}%"
+        for p in ("host_wait", "h2d", "device_excess"))
 
 
 def _fmt_rate(v: Any) -> str:
@@ -308,6 +385,13 @@ def render(result: Dict[str, Any]) -> str:
         lines.append(f"  dominant: {r['dominant']} "
                      f"({r['dominant_share'] * 100:.1f}% of step wall, "
                      f"over {r['steps']} steps)")
+        if r.get("fuse_steps", 1) > 1:
+            lines.append(
+                f"  fusion depth: K={r['fuse_steps']:.0f} "
+                f"({r['dispatches']} dispatches over {r['steps']} steps)")
+        if r.get("residual"):
+            lines.append("  residual per step (vs device ceiling): "
+                         + _fmt_residual(r["residual"]))
         lines.append(f"  attack: {r['attack']}")
     return "\n".join(lines)
 

@@ -20,6 +20,20 @@ a tier-1 test runs it in CI):
    ``put_sharded``) — staging belongs in the prep closure handed to the
    prefetcher, where it runs off the step loop.
 
+ISSUE 7 fused the train steps into per-window ``lax.scan`` dispatches,
+adding two invariants of its own:
+
+3. No host sync (``float()`` / ``.block_until_ready()`` /
+   ``device_get``) inside a ``lax.scan`` body anywhere in ``models/``:
+   a sync inside the scan body either fails to trace or — worse, via a
+   callback — re-serializes the very dispatch cadence the fusion
+   removed.
+4. Supervision sits at the fusion boundary: inside ``_train_attempt``,
+   ``watchdog.arm``/``disarm`` and the ``guard.check*`` family must be
+   called from the dispatch loop itself — present in the step loop, and
+   never from a nested function (a prep closure or scan body would run
+   them off the boundary, or per sub-step).
+
 Usage: ``python tools/lint_trainloop.py [root]`` — prints violations and
 exits non-zero when any exist.
 """
@@ -40,6 +54,11 @@ _REQUIRED = ("two_tower.py", "dlrm.py")
 # Host→device staging primitives banned from step-loop bodies.
 _BANNED_ATTRS = {"asarray", "array", "device_put"}
 _BANNED_NAMES = {"put_sharded", "device_put"}
+# Host-sync primitives banned from lax.scan bodies (rule 3).
+_SYNC_ATTRS = {"block_until_ready", "device_get"}
+_SYNC_NAMES = {"float", "device_get"}
+# Supervision calls that must sit at the fusion boundary (rule 4).
+_BOUNDARY_RECEIVERS = ("watchdog", "guard")
 
 
 def _is_staging_call(node: ast.Call) -> str:
@@ -81,6 +100,71 @@ def _loop_staging_calls(fn: ast.FunctionDef) -> List[ast.Call]:
     return bad
 
 
+def _is_sync_call(node: ast.Call) -> str:
+    """Name of the host-sync primitive this call is, or ''."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+        # x.block_until_ready() / jax.block_until_ready(x) /
+        # jax.device_get(x) all force a host round-trip.
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _SYNC_NAMES:
+        return f.id
+    return ""
+
+
+def _scan_bodies(tree: ast.AST) -> List[ast.AST]:
+    """The function bodies passed to ``lax.scan`` calls: a Name first
+    argument resolves against every FunctionDef of that name in the
+    module (nested defs included — the models define scan bodies inline
+    inside their fused jit entry points); a Lambda is taken as-is."""
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    bodies: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "scan"):
+            continue
+        recv = f.value
+        is_lax = (isinstance(recv, ast.Name) and recv.id == "lax") or (
+            isinstance(recv, ast.Attribute) and recv.attr == "lax")
+        if not is_lax:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Lambda):
+            bodies.append(first)
+        elif isinstance(first, ast.Name):
+            bodies.extend(defs.get(first.id, ()))
+    return bodies
+
+
+def _is_boundary_call(node: ast.Call) -> str:
+    """``watchdog.arm``/``disarm`` / ``guard.check*``-style call, or ''."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in _BOUNDARY_RECEIVERS:
+        if f.value.id == "watchdog" and f.attr in ("arm", "disarm"):
+            return f"watchdog.{f.attr}"
+        if f.value.id == "guard" and f.attr.startswith("check"):
+            return f"guard.{f.attr}"
+    return ""
+
+
+def _nested_function_nodes(fn: ast.AST) -> set:
+    """ids of every node inside a function defined WITHIN ``fn``."""
+    inner: set = set()
+    for node in ast.walk(fn):
+        if node is fn or not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        for sub in ast.walk(node):
+            inner.add(id(sub))
+    return inner
+
+
 def check_source(source: str, filename: str,
                  require_prefetcher: bool = False) -> List[str]:
     """Violations in one module's source (path:line prefixed strings)."""
@@ -89,6 +173,15 @@ def check_source(source: str, filename: str,
         tree = ast.parse(source, filename=filename)
     except SyntaxError as e:
         return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    # Rule 3: host syncs inside lax.scan bodies (anywhere in the module).
+    for body in _scan_bodies(tree):
+        for sub in ast.walk(body):
+            if isinstance(sub, ast.Call) and _is_sync_call(sub):
+                violations.append(
+                    f"{filename}:{sub.lineno}: lax.scan body calls "
+                    f"{_is_sync_call(sub)} — a host sync inside the "
+                    f"fused window re-serializes the dispatch cadence "
+                    f"step fusion exists to remove")
     loops = [n for n in ast.walk(tree)
              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
              and n.name == _LOOP_FN]
@@ -109,6 +202,41 @@ def check_source(source: str, filename: str,
                 f"inside the step loop ({_is_staging_call(call)}) — "
                 f"H2D serializes after the device sync; move staging "
                 f"into the DevicePrefetcher prep/put functions")
+        # Rule 4: supervision at the fusion boundary.
+        nested = _nested_function_nodes(fn)
+        in_loop: set = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                for sub in ast.walk(node):
+                    in_loop.add(id(sub))
+        seen_in_loop: set = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _is_boundary_call(sub)
+            if not name:
+                continue
+            if id(sub) in nested:
+                violations.append(
+                    f"{filename}:{sub.lineno}: {fn.name} calls {name} "
+                    f"from a nested function — supervision belongs at "
+                    f"the fusion boundary (the dispatch loop body), not "
+                    f"inside a prep closure or scan body")
+            elif id(sub) in in_loop:
+                seen_in_loop.add("guard" if name.startswith("guard")
+                                 else name)
+        if require_prefetcher:
+            # Presence is demanded only of the deep models rule 1 names:
+            # helper/experimental loops may legitimately run without
+            # supervision, but the production loops may not lose it.
+            for required, what in (("watchdog.arm", "watchdog.arm"),
+                                   ("guard", "a guard.check* call")):
+                if required not in seen_in_loop:
+                    violations.append(
+                        f"{filename}:{fn.lineno}: {fn.name} never calls "
+                        f"{what} inside its step loop — fused dispatches "
+                        f"must arm the watchdog (K-scaled) and check the "
+                        f"loss vector at every fusion boundary")
     return violations
 
 

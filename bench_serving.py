@@ -322,10 +322,14 @@ def _batcher_delta(before, after):
     }
 
 
-def _drive_level(port: int, n_users: int, clients: int, requests: int):
+def _drive_level(port: int, n_users: int, clients: int, requests: int,
+                 on_warm=None):
     """Closed-loop drive at ONE concurrency level; every request carries
     a deadline header.  No retries — every status is an outcome the
-    sweep records (a 504 is a shed, not a failure to hide)."""
+    sweep records (a 504 is a shed, not a failure to hide).
+
+    ``on_warm`` fires after the warmup requests, before the measured
+    drive — counter scrapes taken there exclude warmup traffic."""
     import socket
 
     rng = np.random.default_rng(2)
@@ -416,6 +420,8 @@ def _drive_level(port: int, n_users: int, clients: int, requests: int):
         one(item)
     with lock:
         outcomes.clear()
+    if on_warm is not None:
+        on_warm()
     t0 = time.perf_counter()
     with concurrent.futures.ThreadPoolExecutor(clients) as ex:
         list(ex.map(one, reqs))
@@ -511,6 +517,155 @@ def _sweep(args) -> None:
     print(json.dumps(record))
 
 
+# --------------------------------------------------------------------------
+# Corpus-scale mode (ISSUE 8): exact vs sharded vs IVF retrieval at
+# 1e5/1e6 items, through the PR-6 scheduler path
+# --------------------------------------------------------------------------
+
+_RETR_METRIC_RE = re.compile(
+    r'^(pio_retrieval_requests_total|pio_retrieval_candidates_total)'
+    r'\{([^}]*)\} (\S+)$')
+
+
+def _scrape_retrieval(port: int):
+    """pio_retrieval_* counters by rung (corpus-scale deltas)."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    out = {}
+    for line in text.splitlines():
+        m = _RETR_METRIC_RE.match(line)
+        if not m:
+            continue
+        rung = dict(kv.split("=") for kv in
+                    m.group(2).replace('"', "").split(",")).get("rung", "?")
+        out.setdefault(rung, {})[m.group(1)] = float(m.group(3))
+    return out
+
+
+def _synth_corpus(n_items: int, n_users: int, dim: int, seed: int = 0):
+    """Clustered synthetic corpus + queries near members — the IVF
+    design target (normalized two-tower-style vectors), built directly
+    so the bench measures RETRIEVAL at scales training can't reach in a
+    bench budget."""
+    rng = np.random.default_rng(seed)
+    n_clusters = max(8, int(round(n_items ** 0.5 / 2)))
+    centers = rng.normal(size=(n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    assign = rng.integers(0, n_clusters, n_items)
+    items = centers[assign] + 0.15 * rng.normal(
+        size=(n_items, dim)).astype(np.float32)
+    items /= np.linalg.norm(items, axis=1, keepdims=True)
+    src = rng.integers(0, n_items, n_users)
+    users = items[src] + 0.05 * rng.normal(
+        size=(n_users, dim)).astype(np.float32)
+    users /= np.linalg.norm(users, axis=1, keepdims=True)
+    return users.astype(np.float32), items.astype(np.float32)
+
+
+def _corpus_scale(args) -> None:
+    """One tiny trained twotower server per scale; the serving wrapper
+    is swapped for a synthetic N-item corpus and the SAME load is driven
+    through the scheduler path with the retrieval rung forced per round
+    (exact single-device → IVF → mesh-sharded; the shard staging happens
+    LAST so the exact baseline really is one device)."""
+    from predictionio_tpu.data.event import BiMap
+    from predictionio_tpu.parallel.mesh import make_mesh
+    from predictionio_tpu.retrieval import Retriever, build_ivf
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.templates.twotower.engine import (
+        TwoTowerModelWrapper,
+    )
+
+    scales = [int(float(x)) for x in args.corpus_scale.split(",")
+              if x.strip()]
+    dim, n_users = 32, 2000
+    record = {"mode": "corpus_scale", "dim": dim,
+              "clients": args.clients,
+              "requests_per_round": args.requests, "scales": {}}
+    eng, variant, storage, _ = _setup("twotower")
+    for n_items in scales:
+        users, items = _synth_corpus(n_items, n_users, dim)
+        t0 = time.perf_counter()
+        ivf = build_ivf(items, force=True)
+        build_s = round(time.perf_counter() - t0, 1)
+        wrapper = TwoTowerModelWrapper(
+            user_vecs=users, item_vecs=items,
+            user_index=BiMap({f"u{j}": j for j in range(n_users)}),
+            item_index=BiMap({f"i{j}": j for j in range(n_items)}),
+            ivf=ivf)
+        # Offline recall@10 of the IVF rung vs exact on a query sample
+        # (the latency rounds below are meaningless if recall collapsed).
+        sample = users[:64]
+        exact_s = sample @ items.T
+        want = np.argsort(-exact_s, axis=1)[:, :10]
+        r = wrapper.retriever()
+        os.environ["PIO_RETRIEVAL_RUNG"] = "ivf"
+        _, ids, info = r.topk(sample, 10)
+        recall = sum(len(set(ids[b, :10]) & set(want[b]))
+                     for b in range(len(sample))) / want.size
+        scanned_frac = info["candidates"] / (len(sample) * n_items)
+        srv = EngineServer(eng, variant, storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        srv._models = [wrapper]  # serve the synthetic generation
+        entry = {"n_items": n_items, "ivf": {
+            "nlist": ivf.nlist, "nprobe": info["nprobe"],
+            "build_s": build_s, "recall_at_10": round(recall, 4),
+            "scanned_fraction": round(scanned_frac, 4)}, "rounds": {}}
+        # Shard staging LAST: once the corpus is mesh-sharded the
+        # "device" rung would no longer be a single-device baseline.
+        for rung in ("device", "ivf", "sharded"):
+            if rung == "sharded":
+                os.environ["PIO_SERVE_SHARD_ABOVE"] = "1"
+                if not r.maybe_shard(make_mesh({"data": 8})):
+                    entry["rounds"]["sharded"] = {
+                        "skipped": "mesh unavailable"}
+                    continue
+            os.environ["PIO_RETRIEVAL_RUNG"] = rung
+            # Scrape AFTER warmup so the counter delta covers exactly
+            # the measured window's facade traffic.
+            before = _scrape_retrieval(srv.port)
+            res = _drive_level(srv.port, n_users, args.clients,
+                               args.requests,
+                               on_warm=lambda: before.update(
+                                   _scrape_retrieval(srv.port)))
+            after = _scrape_retrieval(srv.port)
+            reqs = (after.get(rung, {}).get(
+                "pio_retrieval_requests_total", 0)
+                - before.get(rung, {}).get(
+                    "pio_retrieval_requests_total", 0))
+            cand = (after.get(rung, {}).get(
+                "pio_retrieval_candidates_total", 0)
+                - before.get(rung, {}).get(
+                    "pio_retrieval_candidates_total", 0))
+            # Denominator = answered queries: shed/non-200 requests never
+            # reached the facade, so dividing by requests-sent would
+            # understate slow rungs' scan cost exactly when they shed.
+            answered = res["statuses"].get("200", 0)
+            res["retrieval"] = {
+                "facade_calls": int(reqs),
+                # scanned rows per answered HTTP query at matched load —
+                # the sublinearity claim in one number
+                "candidates_per_query": round(cand / max(answered, 1), 1),
+            }
+            entry["rounds"][rung] = res
+            print(json.dumps({"scale": n_items, "rung": rung, **res}))
+        for k in ("PIO_RETRIEVAL_RUNG", "PIO_SERVE_SHARD_ABOVE"):
+            os.environ.pop(k, None)
+        dev, ivf_r = entry["rounds"].get("device"), \
+            entry["rounds"].get("ivf")
+        if dev and ivf_r and dev.get("p99_ms") and ivf_r.get("p99_ms"):
+            entry["p99_ivf_vs_exact_ms"] = round(
+                ivf_r["p99_ms"] - dev["p99_ms"], 2)
+        record["scales"][str(n_items)] = entry
+        srv.stop()
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -527,8 +682,25 @@ def main():
     ap.add_argument("--engine", default="als",
                     choices=("als", "twotower"),
                     help="engine for the sweep (twotower = deep model)")
+    ap.add_argument("--corpus-scale", default=None, metavar="SCALES",
+                    help="comma-separated item counts (e.g. '1e5,1e6') — "
+                         "drive exact vs sharded vs IVF retrieval over a "
+                         "synthetic clustered corpus at each scale "
+                         "through the scheduler path (ISSUE 8)")
+    ap.add_argument("--out", default=None,
+                    help="write the corpus-scale record to this JSON file")
     args = ap.parse_args()
 
+    if args.corpus_scale:
+        # The sharded round needs a multi-device mesh: force the 8-way
+        # virtual CPU device split BEFORE anything initializes jax.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        _corpus_scale(args)
+        return
     if args.concurrency:
         _sweep(args)
         return

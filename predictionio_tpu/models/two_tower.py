@@ -30,7 +30,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.obs.runtime import get_compile_tracker
-from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
+from predictionio_tpu.ops.topk import top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, put_sharded
 
 __all__ = ["TwoTowerConfig", "TwoTowerState", "init_state", "train_step",
@@ -539,9 +539,19 @@ def encode_items(params: Dict, item_ids: jax.Array) -> jax.Array:
 
 def retrieve(params: Dict, user_ids: jax.Array, n_items: int, k: int,
              *, chunk: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
-    """Top-k MIPS over all item embeddings."""
+    """Top-k MIPS over all item embeddings (train-side eval utility —
+    serving goes through :mod:`predictionio_tpu.retrieval`).
+
+    With ``chunk`` the scan rides :func:`ops.pallas_kernels.fused_topk`:
+    on TPU the fused Pallas kernel scores corpus tiles in VMEM and never
+    materializes the [B, N] score block; elsewhere it falls back to the
+    bounded-memory ``chunked_top_k`` scan (which now auto-pads ragged
+    tails, so any ``n_items`` works).
+    """
+    from predictionio_tpu.ops.pallas_kernels import fused_topk
+
     q = _forward_users(params, user_ids)
     all_items = _forward_items(params, jnp.arange(n_items))
     if chunk:
-        return chunked_top_k(q, all_items, k, chunk=chunk)
+        return fused_topk(q, all_items, k, chunk=chunk)
     return top_k_scores(q, all_items, k)

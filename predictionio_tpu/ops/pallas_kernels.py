@@ -27,7 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_gram_vector", "fused_gram_vector_pallas",
            "fused_gram_vector_xla", "pallas_supported",
-           "ridge_solve_gj_pallas", "ridge_solve_lu_pallas", "gj_fits_vmem"]
+           "ridge_solve_gj_pallas", "ridge_solve_lu_pallas", "gj_fits_vmem",
+           "fused_topk", "fused_topk_pallas"]
 
 
 def pallas_supported() -> bool:
@@ -369,3 +370,146 @@ def fused_gram_vector(f: jax.Array, w: jax.Array, c: jax.Array,
         return fused_gram_vector_pallas(f, w, c,
                                         interpret=not pallas_supported())
     return fused_gram_vector_xla(f, w, c)
+
+
+# ---------------------------------------------------------------------------
+# Fused corpus-score + running top-K (ISSUE 8: million-item retrieval).
+#
+# The XLA retrieval path (ops.topk) either materializes the full [B, N]
+# score block (top_k_scores) or scans [B, chunk] slabs through HBM
+# (chunked_top_k).  This kernel streams corpus tiles into VMEM, scores a
+# tile on the MXU, and folds it into a running top-K held in VMEM — the
+# [B, N] scores never exist anywhere, and HBM traffic is one read of the
+# corpus plus O(B·k) output.  The merge is a k-step extract-max built
+# ONLY from Mosaic-supported primitives (axis reductions, where,
+# broadcasted_iota, pl.ds stores) — no in-kernel sort/top_k dependence —
+# so the selection costs k·(k+T)·B VPU ops per tile: the kernel targets
+# large-N / menu-k serving shapes where the MXU tile score dominates.
+# ---------------------------------------------------------------------------
+
+_TOPK_TILE = 1024        # corpus rows per grid step (lane-aligned)
+_TOPK_NEG_INF = -3.4e38  # matches ops.topk.NEG_INF
+
+
+def _topk_kernel(q_ref, items_ref, out_s_ref, out_i_ref, m_ref, mi_ref,
+                 *, tile: int, k: int, n_real: int):
+    """One corpus tile folded into the running top-k.
+
+    ``m_ref``/``mi_ref`` are [B, k+T] merged-candidate scratch: the first
+    k lanes hold the running best (read back from the output refs, which
+    persist across the sequential TPU grid), the remaining T lanes this
+    tile's scores.  Tail tiles read an OOB-padded block — the garbage
+    columns are overwritten with NEG_INF via the global-id mask before
+    any of them can win a slot (`where` selects, never propagates a NaN).
+    """
+    j = pl.program_id(0)
+    b = q_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        out_s_ref[:] = jnp.full_like(out_s_ref, _TOPK_NEG_INF)
+        out_i_ref[:] = jnp.zeros_like(out_i_ref)
+
+    m_ref[:, :k] = out_s_ref[:]
+    mi_ref[:, :k] = out_i_ref[:]
+    s = jax.lax.dot_general(                     # MXU: [B,D]·[T,D]ᵀ
+        q_ref[:], items_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    gid = j * tile + jax.lax.broadcasted_iota(jnp.int32, (b, tile), 1)
+    m_ref[:, k:] = jnp.where(gid < n_real, s, _TOPK_NEG_INF)
+    mi_ref[:, k:] = gid
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, k + tile), 1)
+
+    def extract(slot, _):
+        m = m_ref[:]
+        v = jnp.max(m, axis=1, keepdims=True)            # [B, 1]
+        # Lowest column among the ties = exactly one winner per row; its
+        # id is recovered with a sum-select (no gather needed).
+        amax = jnp.min(jnp.where(m == v, cols, k + tile),
+                       axis=1, keepdims=True)
+        sel = cols == amax
+        cid = jnp.sum(jnp.where(sel, mi_ref[:], 0), axis=1, keepdims=True)
+        out_s_ref[:, pl.ds(slot, 1)] = v
+        out_i_ref[:, pl.ds(slot, 1)] = cid
+        m_ref[:] = jnp.where(sel, _TOPK_NEG_INF, m)
+        return 0
+
+    jax.lax.fori_loop(0, k, extract, 0, unroll=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tile", "n_valid", "interpret"))
+def fused_topk_pallas(queries: jax.Array, items: jax.Array, k: int, *,
+                      tile: int = _TOPK_TILE,
+                      n_valid: Optional[int] = None,
+                      interpret: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Scores+ids of the top-k items per query — [B,D]·[N,D]ᵀ without
+    ever materializing the [B, N] score block.
+
+    Returns ([B, k] f32, [B, k] int32) sorted descending.  ``n_valid``
+    masks trailing corpus-padding rows.  Tie order is lowest-running-slot
+    first, which can differ from ``lax.top_k``'s lowest-global-id order
+    on exactly-equal scores — callers compare id SETS, not sequences,
+    when scores tie.
+    """
+    b, d = queries.shape
+    n = items.shape[0]
+    assert 1 <= k <= n, f"k={k} outside [1, {n}]"
+    n_real = n if n_valid is None else min(n_valid, n)
+    b_pad = (-b) % TILE_R
+    if b_pad:
+        queries = jnp.pad(queries, ((0, b_pad), (0, 0)))
+    bp = b + b_pad
+    kernel = functools.partial(_topk_kernel, tile=tile, k=k, n_real=n_real)
+    out_s, out_i = pl.pallas_call(
+        kernel,
+        grid=(-(-n // tile),),
+        in_specs=[
+            pl.BlockSpec((bp, d), lambda j: (0, 0)),
+            pl.BlockSpec((tile, d), lambda j: (j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bp, k), lambda j: (0, 0)),
+            pl.BlockSpec((bp, k), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, k), jnp.float32),
+            jax.ShapeDtypeStruct((bp, k), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bp, k + tile), jnp.float32),
+                        pltpu.VMEM((bp, k + tile), jnp.int32)],
+        interpret=interpret,
+    )(queries.astype(jnp.float32), items.astype(jnp.float32))
+    return out_s[:b], out_i[:b]
+
+
+def fused_topk(queries: jax.Array, items: jax.Array, k: int, *,
+               n_valid: Optional[int] = None,
+               use_pallas: Optional[bool] = None,
+               chunk: Optional[int] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Dispatch: fused Pallas kernel on TPU, XLA top-k elsewhere.
+
+    The XLA fallback rides :func:`ops.topk.chunked_top_k` (which folds
+    small corpora into one ``top_k_scores`` dispatch), so callers get
+    bounded score-block memory either way.  ``chunk`` sizes the
+    fallback's scan slab only — the Pallas kernel's VMEM tile is fixed.
+    """
+    from predictionio_tpu.ops.topk import chunked_top_k
+
+    b = queries.shape[0]
+    if k <= 0:
+        return (jnp.zeros((b, 0), jnp.float32),
+                jnp.zeros((b, 0), jnp.int32))
+    k = min(k, items.shape[0])
+    if use_pallas is None:
+        use_pallas = pallas_supported()
+    if use_pallas:
+        return fused_topk_pallas(queries, items, k, n_valid=n_valid,
+                                 interpret=not pallas_supported())
+    if chunk:
+        return chunked_top_k(queries, items, k, chunk=chunk,
+                             n_valid=n_valid)
+    return chunked_top_k(queries, items, k, n_valid=n_valid)

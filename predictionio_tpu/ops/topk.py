@@ -52,41 +52,65 @@ def chunked_top_k(
     *,
     chunk: int = 8192,
     biases: Optional[jax.Array] = None,
+    exclude: Optional[jax.Array] = None,  # [B, N] bool — True = mask out
+    n_valid: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Top-k with bounded [B, chunk] score materialization.
 
     `lax.scan` over item chunks keeps HBM flat for huge catalogs: each step
-    scores one chunk and merges with the running top-k (static shapes, no
-    recompile per catalog size — pad N up to a chunk multiple host-side).
+    scores one chunk and merges with the running top-k.  Any catalog size
+    works — the tail chunk reads a clamped (overlapping) window via
+    ``dynamic_slice`` and masks the rows it re-reads, so callers no longer
+    pad the corpus to a chunk multiple (and no padded copy is ever
+    materialized).  ``n_valid`` additionally masks trailing padding rows a
+    blocked/sharded model carries; ``exclude`` is the per-query mask of
+    :func:`top_k_scores`, sliced chunk-by-chunk.
     """
     n, dim = items.shape
-    assert n % chunk == 0, f"pad catalog ({n}) to a multiple of chunk ({chunk})"
-    steps = n // chunk
-    items_c = items.reshape(steps, chunk, dim)
-    biases_c = (
-        biases.reshape(steps, chunk) if biases is not None
-        else jnp.zeros((steps, chunk), dtype=jnp.float32)
-    )
     b = queries.shape[0]
+    limit = n if n_valid is None else min(n_valid, n)
+    if n <= chunk:
+        # Single-dispatch small corpus: fold the n_valid tail mask into
+        # exclude and take the one-matmul path.
+        excl = exclude
+        if limit < n:
+            pad_rows = jnp.broadcast_to(
+                (jnp.arange(n, dtype=jnp.int32) >= limit)[None, :], (b, n))
+            excl = pad_rows if excl is None else (excl | pad_rows)
+        return top_k_scores(queries, items, k, exclude=excl, biases=biases)
+    steps = -(-n // chunk)
     init = (
         jnp.full((b, k), NEG_INF, dtype=jnp.float32),
         jnp.zeros((b, k), dtype=jnp.int32),
     )
 
-    def step(carry, xs):
+    def step(carry, nominal):
         best_s, best_i = carry
-        chunk_items, chunk_bias, start = xs
-        s = jnp.einsum("bk,nk->bn", queries, chunk_items,
-                       preferred_element_type=jnp.float32) + chunk_bias[None, :]
+        # The tail chunk's window clamps to [n - chunk, n): rows below the
+        # nominal boundary were already scored by the previous chunk and
+        # are masked out below — static shapes, no recompile per catalog
+        # size, no duplicate candidates.
+        start = jnp.minimum(nominal, n - chunk)
+        tile = jax.lax.dynamic_slice(items, (start, 0), (chunk, dim))
+        s = jnp.einsum("bk,nk->bn", queries, tile,
+                       preferred_element_type=jnp.float32)
         ids = start + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+        if biases is not None:
+            s = s + jax.lax.dynamic_slice(biases, (start,), (chunk,))[None, :]
+        invalid = (ids < nominal) | (ids >= limit)
+        if exclude is not None:
+            invalid = invalid | jax.lax.dynamic_slice(
+                exclude, (0, start), (b, chunk))
+        s = jnp.where(invalid, NEG_INF, s)
         merged_s = jnp.concatenate([best_s, s], axis=1)
-        merged_i = jnp.concatenate([best_i, jnp.broadcast_to(ids, s.shape)], axis=1)
+        merged_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(ids, s.shape)], axis=1)
         top_s, pos = jax.lax.top_k(merged_s, k)
         top_i = jnp.take_along_axis(merged_i, pos, axis=1)
         return (top_s, top_i), None
 
     starts = (jnp.arange(steps, dtype=jnp.int32) * chunk)
-    (best_s, best_i), _ = jax.lax.scan(step, init, (items_c, biases_c, starts))
+    (best_s, best_i), _ = jax.lax.scan(step, init, starts)
     return best_s, best_i
 
 

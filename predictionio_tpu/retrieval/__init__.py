@@ -1,0 +1,482 @@
+"""Retrieval subsystem — THE way serving reaches an item corpus.
+
+ISSUE 8: every template's predict path used to hand-roll its own
+host-vs-device-vs-chunked-vs-sharded branching over ``ops.topk``; serve
+latency and HBM grew linearly with catalog size on ONE device.  This
+facade puts three rungs behind one call:
+
+1. **Exact** (``retrieval/exact.py``) — host numpy for small work,
+   single-dispatch device, bounded-memory chunked scan (fused Pallas
+   score+top-K kernel on TPU), and mesh-sharded scoring with an
+   O(k·shards·B) cross-device merge for corpora row-sharded at
+   model-load time.
+2. **IVF** (``retrieval/ivf.py``) — train-time k-means coarse index,
+   sublinear candidate scan, versioned with the model generation via a
+   corpus fingerprint (an index that does not match the vectors it is
+   served next to is dropped loudly, never silently mis-served).
+3. **Fused kernel** (``ops/pallas_kernels.fused_topk``) — rides inside
+   the chunked rung where the backend supports it.
+
+Templates hold ONE :class:`Retriever` per loaded model (via
+:func:`cached_retriever` — weak-keyed, so it dies with the generation)
+and call :meth:`Retriever.topk`.  ``tools/lint_retrieval.py`` pins the
+invariant: no template or server handler may call ``ops.topk``
+primitives directly.
+
+Routing knobs (all read per request, so ops can retune a live server):
+
+- ``PIO_RETRIEVAL_RUNG`` — auto|host|device|chunked|sharded|ivf (force)
+- ``PIO_SERVE_HOST_MACS`` — host fast path when B·N·D is at or below
+  this (default 2e8): one device dispatch round-trip costs more than
+  that many host MACs, which is exactly the lone-client B=1 case
+- ``PIO_SERVE_CHUNK_ABOVE`` — chunked scan above this many items
+- ``PIO_SERVE_SHARD_ABOVE`` — shard-at-load threshold (see
+  :meth:`Retriever.maybe_shard`)
+- ``PIO_IVF_NPROBE`` — IVF lists probed per query
+
+Observability: ``pio_retrieval_requests_total{rung}``,
+``pio_retrieval_candidates_total{rung}`` (rows actually scored),
+``pio_retrieval_ms{rung}``, and a ``retrieval`` span (rung, k, nprobe,
+candidates, batch) in the live request's trace tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.obs import get_registry, span
+from predictionio_tpu.retrieval import exact as _exact
+from predictionio_tpu.retrieval.ivf import (
+    IVFIndex,
+    build_ivf,
+    corpus_fingerprint,
+    ivf_build_config,
+    search_ivf_device,
+    search_ivf_host,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Retriever", "Plan", "cached_retriever", "iter_hits",
+           "build_train_index", "IVFIndex", "build_ivf",
+           "corpus_fingerprint", "K_MENU"]
+
+# Compiled-program menu (SURVEY §7): K pads up so the serving frontend's
+# varying ``num`` values hit a handful of XLA programs, not one each.
+K_MENU = (1, 10, 100, 1000)
+_NEG_SENTINEL = -1e37  # scores at/below this are padding, never results
+
+RUNGS = ("host", "device", "chunked", "sharded", "ivf")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)) or default)
+    except ValueError:
+        return default
+
+
+def menu_k(num: int, n_items: int) -> int:
+    return min(n_items, next((m for m in K_MENU if m >= num), num))
+
+
+@dataclasses.dataclass
+class Plan:
+    """One routing decision — exposed for tests and the trace span."""
+
+    rung: str
+    k: int
+    nprobe: int = 0
+
+
+class Retriever:
+    """Facade over the retrieval rungs for ONE item corpus.
+
+    ``item_vecs`` may be a host numpy array or a jax array (possibly
+    row-sharded over a mesh; possibly carrying padding rows past
+    ``n_items``).  The retriever lazily stages whatever copies its rungs
+    need (host copy, device copy, sharded copy) — at most one of each,
+    built under a process-wide lock.
+    """
+
+    def __init__(self, item_vecs, *, n_items: Optional[int] = None,
+                 ivf: Optional[IVFIndex] = None, name: str = "default",
+                 host_fn=None):
+        self._vecs = item_vecs
+        self.n_items = int(n_items if n_items is not None
+                           else item_vecs.shape[0])
+        self.dim = int(item_vecs.shape[1])
+        self.name = name
+        self._host_fn = host_fn
+        self._host: Optional[np.ndarray] = None
+        self._dev = None
+        self._jit: Dict = {}
+        # RLock: ivf_index() validates the fingerprint under the lock and
+        # that validation stages host_vecs(), which locks again.
+        self._lock = threading.RLock()
+        self._ivf_raw = ivf
+        self._ivf: Optional[IVFIndex] = None
+        self._ivf_checked = False
+        self._ivf_dev = None
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "pio_retrieval_requests_total",
+            "Corpus retrievals by rung.", ("rung", "corpus"))
+        self._m_candidates = reg.counter(
+            "pio_retrieval_candidates_total",
+            "Candidate item rows actually scored.", ("rung", "corpus"))
+        self._m_latency = reg.histogram(
+            "pio_retrieval_ms", "Retrieval latency per rung.", ("rung",))
+        self._m_ivf_rejected = reg.counter(
+            "pio_retrieval_ivf_rejected_total",
+            "IVF indexes dropped for a fingerprint mismatch with the "
+            "served corpus.", ("corpus",))
+
+    # -- corpus staging -----------------------------------------------------
+
+    @property
+    def vecs(self):
+        """The corpus array currently backing retrieval (numpy, device,
+        or mesh-sharded — whatever :meth:`maybe_shard` last staged).
+        Callers that keep their own reference (the model wrapper) sync
+        from here after a re-shard so the pre-shard copy can be freed."""
+        return self._vecs
+
+    @property
+    def sharded(self) -> bool:
+        sh = getattr(self._vecs, "sharding", None)
+        try:
+            from jax.sharding import NamedSharding
+        except Exception:  # pragma: no cover - jax always present in prod
+            return False
+        return (isinstance(sh, NamedSharding) and bool(sh.spec)
+                and sh.spec[0] is not None
+                and self._vecs.shape[0] % sh.mesh.shape[sh.spec[0]] == 0)
+
+    def host_vecs(self) -> np.ndarray:
+        """[n_items, D] numpy copy (trimmed of padding rows)."""
+        if self._host is None:
+            with self._lock:
+                if self._host is None:
+                    if self._host_fn is not None:
+                        self._host = np.asarray(self._host_fn(),
+                                                dtype=np.float32)
+                    else:
+                        import jax
+
+                        self._host = np.asarray(
+                            jax.device_get(self._vecs),
+                            dtype=np.float32)[: self.n_items]
+        return self._host
+
+    def device_vecs(self):
+        """Unsharded device copy — staged ONCE, reused across requests
+        (the old per-request ``jnp.asarray(model.item_vecs)`` uploaded
+        the whole corpus on every predict)."""
+        if self.sharded:
+            return self._vecs
+        if self._dev is None:
+            with _exact.SERVE_CACHE_LOCK:
+                if self._dev is None:
+                    import jax.numpy as jnp
+
+                    self._dev = jnp.asarray(self._vecs, jnp.float32)
+        return self._dev
+
+    def maybe_shard(self, mesh, *, axis: Optional[str] = None) -> bool:
+        """Row-shard the corpus over ``mesh`` at model-load time.
+
+        The post_load hook's contract (SURVEY §3.2 re-parallelization):
+        above ``PIO_SERVE_SHARD_ABOVE`` items the corpus is padded
+        HOST-side (a device-side pad would stage the full corpus on one
+        chip first — OOM at exactly the scale this targets) and
+        device_put shard-by-shard; predict then routes through the
+        sharded rung.  Returns True when the corpus was (re)sharded.
+        """
+        if mesh is None:
+            return False
+        from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
+
+        axis = axis or AXIS_DATA
+        if axis not in mesh.shape:
+            return False
+        if self.n_items <= _env_int("PIO_SERVE_SHARD_ABOVE", 1_000_000):
+            return False
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        host = self.host_vecs()
+        d = mesh.shape[axis]
+        pad = (-host.shape[0]) % d
+        vecs = np.pad(host, ((0, pad), (0, 0))) if pad else host
+        self._vecs = put_sharded(vecs, mesh, NamedSharding(mesh, P(axis)))
+        self._dev = None
+        self._jit = {}
+        return True
+
+    # -- IVF lifecycle ------------------------------------------------------
+
+    def ivf_index(self) -> Optional[IVFIndex]:
+        """The generation's IVF index, fingerprint-validated ONCE against
+        the corpus actually being served.  A mismatch (index from another
+        generation next to these vectors) drops the index and counts —
+        exact serving continues, recall never silently collapses."""
+        if self._ivf_checked:
+            return self._ivf
+        with self._lock:
+            if self._ivf_checked:
+                return self._ivf
+            idx = self._ivf_raw
+            if idx is not None:
+                if (idx.n_items != self.n_items or idx.dim != self.dim
+                        or idx.fingerprint
+                        != corpus_fingerprint(self.host_vecs())):
+                    logger.error(
+                        "IVF index fingerprint mismatch for corpus %r "
+                        "(index n=%d/d=%d vs corpus n=%d/d=%d) — dropping "
+                        "the index; serving stays exact", self.name,
+                        idx.n_items, idx.dim, self.n_items, self.dim)
+                    self._m_ivf_rejected.inc(corpus=self.name)
+                    idx = None
+            self._ivf = idx
+            self._ivf_checked = True
+        return self._ivf
+
+    def ivf_device_arrays(self):
+        """Centroids ``[C, D]`` + padded lists ``[C, L]`` staged on
+        device ONCE per generation — index constants; re-uploading them
+        per request is the same trap the staged corpus copy closed."""
+        if self._ivf_dev is None:
+            with _exact.SERVE_CACHE_LOCK:
+                if self._ivf_dev is None:
+                    import jax.numpy as jnp
+
+                    idx = self.ivf_index()
+                    self._ivf_dev = (jnp.asarray(idx.centroids),
+                                     jnp.asarray(idx.lists))
+        return self._ivf_dev
+
+    # -- routing ------------------------------------------------------------
+
+    def plan(self, b: int, num: int, *, has_exclude: bool = False) -> Plan:
+        k = menu_k(num, self.n_items)
+        forced = os.environ.get("PIO_RETRIEVAL_RUNG", "auto").strip().lower()
+        if forced not in RUNGS and forced not in ("", "auto"):
+            # An unrecognized forcing must degrade as loudly as an
+            # impossible one — a typo'd bench must not silently measure
+            # auto routing.
+            logger.warning("PIO_RETRIEVAL_RUNG=%r is not one of %s; "
+                           "auto routing", forced, ("auto",) + RUNGS)
+        if forced in RUNGS:
+            if has_exclude and forced not in ("host", "device", "chunked"):
+                # The sharded/IVF executors take no per-request mask —
+                # honoring the exclusion beats honoring the forcing (a
+                # blacklisted item must never be returned).
+                logger.warning(
+                    "PIO_RETRIEVAL_RUNG=%s cannot honor a per-request "
+                    "exclude mask for corpus %r; serving exact", forced,
+                    self.name)
+                forced = "auto"
+            if forced == "sharded" and not self.sharded:
+                logger.warning("PIO_RETRIEVAL_RUNG=sharded but corpus %r "
+                               "is not mesh-sharded; serving exact-device",
+                               self.name)
+                forced = "device"
+            if forced == "ivf" and self.ivf_index() is None:
+                logger.warning("PIO_RETRIEVAL_RUNG=ivf but corpus %r has "
+                               "no valid index; serving exact", self.name)
+                forced = "auto"
+            if forced in RUNGS:
+                return self._finish_plan(forced, b, k)
+        work = b * self.n_items * self.dim
+        host_macs = _env_int("PIO_SERVE_HOST_MACS", 2 * 10 ** 8)
+        if has_exclude:
+            # Per-request [B, N] masks ride the exact rungs only (an
+            # excluded id must never cost recall the way an unprobed
+            # IVF cell would); past the chunk threshold the mask rides
+            # the scan so score memory stays bounded at [B, chunk].
+            if work <= host_macs:
+                return self._finish_plan("host", b, k)
+            if self.n_items > _env_int("PIO_SERVE_CHUNK_ABOVE", 2_000_000):
+                return self._finish_plan("chunked", b, k)
+            return self._finish_plan("device", b, k)
+        if self.ivf_index() is not None:
+            return self._finish_plan("ivf", b, k)
+        if work <= host_macs:
+            return self._finish_plan("host", b, k)
+        if self.sharded:
+            return self._finish_plan("sharded", b, k)
+        if self.n_items > _env_int("PIO_SERVE_CHUNK_ABOVE", 2_000_000):
+            return self._finish_plan("chunked", b, k)
+        return self._finish_plan("device", b, k)
+
+    def _finish_plan(self, rung: str, b: int, k: int) -> Plan:
+        if rung != "ivf":
+            return Plan(rung=rung, k=k)
+        idx = self.ivf_index()
+        # Static-shape guard: the probed lists must reach k REAL
+        # candidates even for the query landing on the shortest lists.
+        nprobe = min(idx.nlist,
+                     max(idx.default_nprobe(), idx.min_nprobe_for(k)))
+        return Plan(rung="ivf", k=k, nprobe=nprobe)
+
+    # -- the one entry point ------------------------------------------------
+
+    def topk(self, queries: np.ndarray, num: int, *,
+             exclude: Optional[np.ndarray] = None
+             ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+        """Top-k over the corpus for query VECTORS ``[B, D]``.
+
+        Returns ``([B, k] scores, [B, k] int32 ids, info)`` with
+        ``k = menu_k(num) ≤ n_items`` — callers slice ``[:num]`` per row
+        (:func:`iter_hits` skips padding sentinels).  ``exclude`` is an
+        optional ``[B, n_items]`` bool mask (True = never return).
+        """
+        q = np.ascontiguousarray(queries, dtype=np.float32)
+        b = q.shape[0]
+        p = self.plan(b, num, has_exclude=exclude is not None)
+        t0 = time.perf_counter()
+        with span("retrieval", corpus=self.name, rung=p.rung, batch=b,
+                  k=p.k) as sp:
+            scores, ids, scanned = self._execute(q, p, exclude)
+            if p.rung == "ivf":
+                sp.set(nprobe=p.nprobe)
+            sp.set(candidates=scanned)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._m_requests.inc(rung=p.rung, corpus=self.name)
+        self._m_candidates.inc(scanned, rung=p.rung, corpus=self.name)
+        self._m_latency.observe(ms, rung=p.rung)
+        info = {"rung": p.rung, "k": p.k, "nprobe": p.nprobe,
+                "candidates": scanned, "ms": ms}
+        return scores, ids, info
+
+    def _execute(self, q: np.ndarray, p: Plan,
+                 exclude: Optional[np.ndarray]):
+        b = q.shape[0]
+        if p.rung == "host":
+            s, i = _exact.exact_host(q, self.host_vecs(), p.k,
+                                     exclude=exclude)
+            return s, i, b * self.n_items
+        if p.rung == "ivf":
+            idx = self.ivf_index()
+            # The sub-linear scan keeps the same host-vs-device economics
+            # as the exact rungs, judged on the rows actually scored.
+            est = b * p.nprobe * idx.pad_len * self.dim
+            if est <= _env_int("PIO_SERVE_HOST_MACS", 2 * 10 ** 8):
+                return search_ivf_host(idx, self.host_vecs(), q, p.k,
+                                       p.nprobe)
+            qp = _pow2_pad(q)
+            s, i, scanned = search_ivf_device(
+                idx, self.device_vecs(), qp, p.k, p.nprobe,
+                jit_cache=self._jit, consts=self.ivf_device_arrays())
+            # scanned counts the padded batch's probes; rescale to real.
+            return s[:b], i[:b], int(scanned * b / max(len(qp), 1))
+        qp = _pow2_pad(q)
+        if exclude is not None and len(qp) > b:
+            # The pow2 pad added all-zero query rows; give them
+            # all-False mask rows so shapes stay aligned.
+            exclude = np.concatenate(
+                [exclude, np.zeros((len(qp) - b, exclude.shape[1]),
+                                   dtype=bool)])
+        if p.rung == "sharded":
+            s, i = _exact.exact_sharded(qp, self._vecs, self.n_items, p.k,
+                                        jit_cache=self._jit)
+        elif p.rung == "chunked":
+            s, i = _exact.exact_chunked(qp, self.device_vecs(),
+                                        self.n_items, p.k,
+                                        jit_cache=self._jit,
+                                        exclude=exclude)
+        else:
+            s, i = _exact.exact_device(qp, self.device_vecs(),
+                                       self.n_items, p.k,
+                                       jit_cache=self._jit,
+                                       exclude=exclude)
+        return s[:b], i[:b], b * self.n_items
+
+
+def _pow2_pad(q: np.ndarray) -> np.ndarray:
+    """Pad the batch to the next power of two (compiled-program menu)."""
+    b = q.shape[0]
+    pad = (1 << max(b - 1, 0).bit_length()) - b
+    if pad:
+        return np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
+    return q
+
+
+def iter_hits(scores_row, ids_row, num: int) -> Iterator[Tuple[int, float]]:
+    """(item_id, score) pairs of one result row, sentinel-padding
+    skipped, at most ``num`` — the one loop every template's
+    result-building shares."""
+    taken = 0
+    for s, i in zip(scores_row, ids_row):
+        if taken >= num:
+            return
+        if i < 0 or s <= _NEG_SENTINEL:
+            continue
+        yield int(i), float(s)
+        taken += 1
+
+
+# -- per-model retriever cache ----------------------------------------------
+
+_RETRIEVERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_RETRIEVERS_LOCK = threading.Lock()
+
+
+def cached_retriever(owner, build) -> Retriever:
+    """ONE retriever per loaded model object, built lazily, dying with
+    the generation (weak-keyed — a swapped-out model wrapper releases
+    its staged corpus copies with itself).  Keeping the cache OUT of the
+    wrapper dataclasses means nothing jit- or device-shaped ever rides
+    the model pickle."""
+    r = _RETRIEVERS.get(owner)
+    if r is None:
+        with _RETRIEVERS_LOCK:
+            r = _RETRIEVERS.get(owner)
+            if r is None:
+                r = build()
+                _RETRIEVERS[owner] = r
+    return r
+
+
+def build_train_index(item_vecs: np.ndarray, *, name: str,
+                      seed: Optional[int] = None,
+                      require_explicit: bool = False
+                      ) -> Optional[IVFIndex]:
+    """Train-time IVF build under the env policy (``PIO_IVF`` /
+    ``PIO_IVF_NLIST`` / ``PIO_IVF_MIN_ITEMS``) — called by template
+    ``train()`` so the index is serialized inside the SAME model
+    artifact the generation swap moves.
+
+    ``require_explicit`` is for norm-variant corpora (raw ALS factors,
+    popularity-scaled norms): k-means cells partition by direction, so a
+    high-norm item in an unprobed cell is an unrecoverable miss — the
+    index builds only under an explicit ``PIO_IVF=on``, never ``auto``.
+    """
+    if require_explicit:
+        mode = os.environ.get("PIO_IVF", "auto").strip().lower() or "auto"
+        if mode not in ("on", "1", "true", "yes"):
+            logger.debug("IVF build skipped for %r: norm-variant corpus "
+                         "needs explicit PIO_IVF=on (got %r)", name, mode)
+            return None
+    build, nlist, min_items = ivf_build_config(len(item_vecs))
+    if not build:
+        logger.debug("IVF build skipped for %r (n=%d < min=%d or PIO_IVF "
+                     "off)", name, len(item_vecs), min_items)
+        return None
+    t0 = time.perf_counter()
+    # seed=None (templates with no configured seed) pins to 0 — two
+    # trains over identical data must build identical indexes, or recall
+    # characteristics and bench comparisons drift run-to-run.
+    idx = build_ivf(np.asarray(item_vecs, dtype=np.float32), nlist=nlist,
+                    seed=0 if seed is None else seed, force=True)
+    logger.info("IVF index for %r built in %.1fs (nlist=%d)", name,
+                time.perf_counter() - t0, idx.nlist if idx else -1)
+    return idx

@@ -1,0 +1,301 @@
+"""Train-time IVF coarse index — sublinear candidate selection for MIPS.
+
+The exact rungs (retrieval/exact.py) scan every corpus row per query.
+An IVF (inverted-file) index trades a bounded recall loss for a
+sublinear scan: k-means centroids partition the corpus at ``pio train``
+time; at serve time a query scores only the ``nprobe`` nearest lists.
+
+Design points (ISSUE 8):
+
+- **Padded lists, static shapes.**  Every inverted list is padded to the
+  longest list's length with ``-1`` sentinels, so the device search is
+  one jitted program per (B, k, nprobe) — no recompile per corpus, no
+  ragged gathers.  The host search uses the same arrays.
+- **Versioned with the model generation.**  The index carries a
+  fingerprint of the exact vector matrix it was built over; the facade
+  refuses (and drops) an index whose fingerprint does not match the
+  corpus it is being served next to.  Because the index travels INSIDE
+  the pickled model wrapper, the staged-reload/rollback path (ISSUE 4/6)
+  swaps index+model atomically by construction — the fingerprint check
+  is the tripwire that makes a future regression loud instead of a
+  silent recall collapse.
+- **Exact fallback below a size threshold.**  Brute force over a small
+  corpus is faster than any index walk; ``build_ivf`` returns ``None``
+  under ``PIO_IVF_MIN_ITEMS`` and the facade never picks the IVF rung
+  there.
+
+Knobs: ``PIO_IVF`` (auto|on|off — build policy at train time),
+``PIO_IVF_NLIST`` (centroid count, default ~sqrt(N)),
+``PIO_IVF_NPROBE`` (lists scanned per query, default ~nlist/8),
+``PIO_IVF_MIN_ITEMS`` (exact-fallback threshold, default 50k).
+
+When NOT to use IVF: corpora with heavy vector-norm variance (e.g. raw
+ALS factors with popularity-scaled norms) — k-means cells partition by
+direction, a high-norm item in an unprobed cell is an unrecoverable
+miss.  Normalized embedding corpora (the two-tower tower outputs) are
+the design target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IVFIndex", "build_ivf", "ivf_build_config", "corpus_fingerprint",
+           "search_ivf_host", "search_ivf_device", "DEFAULT_MIN_ITEMS"]
+
+DEFAULT_MIN_ITEMS = 50_000
+_NEG_INF = np.float32(-3.4e38)
+
+
+def corpus_fingerprint(vecs: np.ndarray) -> str:
+    """Stable identity of a vector matrix (shape + content digest).
+
+    Hashed over the contiguous f32 bytes so the SAME vectors loaded from
+    a pickle round-trip fingerprint identically; ~100 ms at the 1e6×64
+    scale, paid once per index build and once per model load.
+    """
+    a = np.ascontiguousarray(vecs, dtype=np.float32)
+    h = hashlib.sha1()
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class IVFIndex:
+    """K-means centroids + padded inverted lists over one item corpus.
+
+    Pickled inside the model wrapper it indexes — model and index are ONE
+    serialized artifact, so a generation swap can never mix them.
+    """
+
+    centroids: np.ndarray      # [C, D] f32
+    lists: np.ndarray          # [C, L] int32, -1 = padding
+    list_lengths: np.ndarray   # [C] int32 — true (unpadded) lengths
+    n_items: int
+    dim: int
+    nlist: int
+    pad_len: int               # L
+    fingerprint: str           # corpus_fingerprint of the indexed vectors
+
+    def default_nprobe(self) -> int:
+        """Serve-time probe width: env override, else ~nlist/8 (≥ 1) —
+        the default that holds recall@10 ≥ 0.95 on clustered corpora
+        while scanning well under a quarter of the candidates."""
+        raw = os.environ.get("PIO_IVF_NPROBE", "").strip()
+        if raw:
+            try:
+                return max(1, min(int(raw), self.nlist))
+            except ValueError:
+                pass
+        return max(1, -(-self.nlist // 8))
+
+    def candidates_scanned(self, probe_ids: np.ndarray) -> int:
+        """True candidate rows scored for a [B, P] probe assignment."""
+        return int(self.list_lengths[probe_ids].sum())
+
+    def min_nprobe_for(self, k: int) -> int:
+        """Smallest probe width that guarantees ≥ k REAL candidates for
+        any query — worst case, it probes the nprobe SHORTEST lists, so
+        the bound must use true list lengths.  ``nprobe · pad_len``
+        overcounts skewed clusters (one giant list sets the pad while
+        typical lists hold a handful of items) and silently returns
+        fewer than k results."""
+        cum = getattr(self, "_worst_case_cum", None)
+        if cum is None:
+            cum = np.cumsum(np.sort(np.asarray(self.list_lengths,
+                                               dtype=np.int64)))
+            self._worst_case_cum = cum
+        if cum[-1] < k:
+            return self.nlist
+        return int(np.searchsorted(cum, k)) + 1
+
+
+def ivf_build_config(n_items: int) -> Tuple[bool, int, int]:
+    """(should_build, nlist, min_items) from the env at train time."""
+    mode = os.environ.get("PIO_IVF", "auto").strip().lower() or "auto"
+    try:
+        min_items = int(os.environ.get("PIO_IVF_MIN_ITEMS",
+                                       str(DEFAULT_MIN_ITEMS)))
+    except ValueError:
+        min_items = DEFAULT_MIN_ITEMS
+    if mode in ("off", "0", "false", "no"):
+        return False, 0, min_items
+    if n_items < min_items:
+        # Exact fallback: below the threshold brute force wins — never
+        # build (mode=on included; the threshold IS the contract).
+        return False, 0, min_items
+    raw = os.environ.get("PIO_IVF_NLIST", "").strip()
+    nlist = 0
+    if raw:
+        try:
+            nlist = max(1, min(int(raw), n_items))
+        except ValueError:
+            logger.warning("PIO_IVF_NLIST=%r is not an integer; using "
+                           "the ~sqrt(N) default", raw)
+    if not nlist:
+        nlist = max(1, min(int(round(float(n_items) ** 0.5)), n_items))
+    return True, nlist, min_items
+
+
+def build_ivf(item_vecs: np.ndarray, *, nlist: Optional[int] = None,
+              iters: int = 6, sample: int = 65_536, seed: int = 0,
+              force: bool = False) -> Optional[IVFIndex]:
+    """Spherical k-means index over ``item_vecs`` ([N, D] host array).
+
+    Mini-batch flavored: centroids train on a deterministic sample (the
+    full assignment pass is the only full-corpus scan), so build cost is
+    bounded at ML-25M scale.  Returns ``None`` when the env policy says
+    exact-only (``force=True`` skips the policy for tests/benches, not
+    the math).
+    """
+    vecs = np.ascontiguousarray(item_vecs, dtype=np.float32)
+    n, d = vecs.shape
+    if force:
+        c = nlist or max(1, min(int(round(float(n) ** 0.5)), n))
+    else:
+        build, c, _ = ivf_build_config(n)
+        if not build:
+            return None
+        c = nlist or c
+    c = max(1, min(c, n))
+    rng = np.random.default_rng(seed)
+    # Direction-only clustering: normalize a working copy so cells
+    # partition the sphere (MIPS over normalized corpora ≡ cosine).
+    norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+    unit = vecs / np.where(norms < 1e-9, 1.0, norms)
+    train = unit[rng.choice(n, size=min(sample, n), replace=False)] \
+        if n > sample else unit
+    centroids = train[rng.choice(len(train), size=c, replace=False)].copy()
+    for _ in range(iters):
+        # [S, C] cosine scores; argmax assignment; mean + renormalize.
+        assign = np.argmax(train @ centroids.T, axis=1)
+        for ci in range(c):
+            members = train[assign == ci]
+            if len(members):
+                centroids[ci] = members.mean(axis=0)
+        cn = np.linalg.norm(centroids, axis=1, keepdims=True)
+        centroids = centroids / np.where(cn < 1e-9, 1.0, cn)
+    # Full assignment pass, chunked so the [chunk, C] block stays small.
+    assign = np.empty(n, dtype=np.int64)
+    step = max(1, 4_194_304 // max(c, 1))
+    for s in range(0, n, step):
+        assign[s:s + step] = np.argmax(unit[s:s + step] @ centroids.T, axis=1)
+    counts = np.bincount(assign, minlength=c)
+    pad_len = max(1, int(counts.max()))
+    lists = np.full((c, pad_len), -1, dtype=np.int32)
+    fill = np.zeros(c, dtype=np.int64)
+    order = np.argsort(assign, kind="stable")
+    for idx in order:
+        ci = assign[idx]
+        lists[ci, fill[ci]] = idx
+        fill[ci] += 1
+    index = IVFIndex(
+        centroids=centroids.astype(np.float32),
+        lists=lists,
+        list_lengths=counts.astype(np.int32),
+        n_items=n, dim=d, nlist=c, pad_len=pad_len,
+        fingerprint=corpus_fingerprint(vecs),
+    )
+    logger.info("built IVF index: %d items → %d lists (pad_len=%d, "
+                "mean len %.1f)", n, c, pad_len, counts.mean())
+    return index
+
+
+def search_ivf_host(index: IVFIndex, item_vecs: np.ndarray,
+                    queries: np.ndarray, k: int, nprobe: int
+                    ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Numpy IVF search — the serving fast path for small batches.
+
+    Returns ([B, k] f32 scores, [B, k] int32 ids, candidates scanned).
+    Rows with fewer than k reachable candidates pad with NEG_INF/-1.
+    """
+    q = np.ascontiguousarray(queries, dtype=np.float32)
+    b = q.shape[0]
+    nprobe = max(1, min(nprobe, index.nlist))
+    cq = q @ index.centroids.T                         # [B, C]
+    if nprobe < index.nlist:
+        probe = np.argpartition(-cq, nprobe - 1, axis=1)[:, :nprobe]
+    else:
+        probe = np.broadcast_to(np.arange(index.nlist), (b, index.nlist))
+    out_s = np.full((b, k), _NEG_INF, dtype=np.float32)
+    out_i = np.full((b, k), -1, dtype=np.int32)
+    for row in range(b):
+        cand = index.lists[probe[row]].ravel()
+        cand = cand[cand >= 0]
+        if cand.size == 0:
+            continue
+        sc = item_vecs[cand] @ q[row]
+        kk = min(k, sc.size)
+        part = np.argpartition(-sc, kk - 1)[:kk] if kk < sc.size \
+            else np.arange(sc.size)
+        order = part[np.argsort(-sc[part], kind="stable")]
+        out_s[row, :kk] = sc[order]
+        out_i[row, :kk] = cand[order]
+    return out_s, out_i, index.candidates_scanned(probe)
+
+
+def _device_search_impl(queries, centroids, lists, items, *, k: int,
+                        nprobe: int):
+    import jax
+    import jax.numpy as jnp
+
+    cq = jnp.einsum("bd,cd->bc", queries, centroids,
+                    preferred_element_type=jnp.float32)
+    _, probe = jax.lax.top_k(cq, nprobe)               # [B, P]
+    cand = lists[probe].reshape(queries.shape[0], -1)  # [B, P·L]
+    vecs = items[jnp.maximum(cand, 0)]                 # [B, P·L, D]
+    sc = jnp.einsum("bd,bnd->bn", queries, vecs,
+                    preferred_element_type=jnp.float32)
+    sc = jnp.where(cand < 0, jnp.float32(_NEG_INF), sc)
+    top_s, pos = jax.lax.top_k(sc, k)
+    return top_s, jnp.take_along_axis(cand, pos, axis=1), probe
+
+
+def search_ivf_device(index: IVFIndex, items_dev, queries,
+                      k: int, nprobe: int, *, jit_cache: dict,
+                      consts: Optional[tuple] = None
+                      ) -> Tuple["np.ndarray", "np.ndarray", int]:
+    """Jitted static-shape IVF search for larger batches.
+
+    One compiled program per (B, k, nprobe) — the padded [C, L] lists
+    make every gather static.  ``jit_cache`` is the caller's per-corpus
+    compiled-program cache (keyed here, owned there so a model reload
+    drops it with the corpus).  ``consts`` is the caller's pre-staged
+    ``(centroids, lists)`` device pair — generation constants that must
+    not be re-uploaded per request on the serving hot path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.retrieval.exact import SERVE_CACHE_LOCK
+
+    b = queries.shape[0]
+    nprobe = max(1, min(nprobe, index.nlist))
+    key = ("ivf", b, k, nprobe)
+    fn = jit_cache.get(key)
+    if fn is None:
+        # Same cold-build discipline as the exact rungs: a burst of
+        # concurrent first requests must trace ONE program, not one each.
+        with SERVE_CACHE_LOCK:
+            fn = jit_cache.get(key)
+            if fn is None:
+                fn = jax.jit(partial(_device_search_impl, k=k,
+                                     nprobe=nprobe))
+                jit_cache[key] = fn
+    cent, lists = consts if consts is not None else (
+        jnp.asarray(index.centroids), jnp.asarray(index.lists))
+    top_s, top_i, probe = fn(jnp.asarray(queries, jnp.float32),
+                             cent, lists, items_dev)
+    top_s, top_i, probe = jax.device_get((top_s, top_i, probe))
+    return (np.asarray(top_s), np.asarray(top_i, np.int32),
+            index.candidates_scanned(np.asarray(probe)))

@@ -36,7 +36,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
-from predictionio_tpu.ops.topk import host_top_k
+from predictionio_tpu.retrieval import Retriever, cached_retriever, iter_hits
 
 __all__ = [
     "Query", "ItemScore", "PredictedResult", "TrainingData",
@@ -132,7 +132,9 @@ class ECommAlgorithmParams(Params):
     seed: Optional[int] = None
 
 
-@dataclasses.dataclass
+# eq=False: wrapper identity IS the model generation (weak-keyed
+# retriever cache needs a hashable owner).
+@dataclasses.dataclass(eq=False)
 class ECommModel:
     user_factors: np.ndarray
     item_factors: np.ndarray
@@ -140,6 +142,12 @@ class ECommModel:
     item_index: BiMap
     item_categories: Dict[str, Set[str]]
     view_counts: np.ndarray
+
+    def retriever(self) -> Retriever:
+        """THE serving route to the item corpus (retrieval facade)."""
+        return cached_retriever(self, lambda: Retriever(
+            self.item_factors, n_items=len(self.item_index),
+            name="ecommerce"))
 
 
 class ECommAlgorithm(Algorithm):
@@ -236,14 +244,14 @@ class ECommAlgorithm(Algorithm):
 
         uidx = model.user_index.get(query.user)
         if uidx is not None:
-            # Host fast path: factors are host-resident numpy; a B=1
-            # predict is far below one device dispatch round-trip.
-            scores, ids = host_top_k(
-                model.user_factors[uidx][None, :], model.item_factors,
-                min(query.num, n_items), exclude=exclude)
-            pairs = [(float(s), int(i))
-                     for s, i in zip(scores[0], ids[0])
-                     if s > -1e37]
+            # Facade retrieval with the per-request exclude mask: the
+            # planner routes a B=1 predict through its host fast path
+            # and pins exclude-carrying queries to the exact rungs.
+            scores, ids, _info = model.retriever().topk(
+                model.user_factors[uidx][None, :], query.num,
+                exclude=exclude)
+            pairs = [(s, i) for i, s in iter_hits(scores[0], ids[0],
+                                                  query.num)]
         else:
             # Popularity fallback (reference: predictDefault).
             counts = np.where(exclude[0], -np.inf, model.view_counts)

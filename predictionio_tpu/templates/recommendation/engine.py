@@ -18,13 +18,11 @@ equations) instead of Spark MLlib; serving top-K is one MXU matmul +
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
-import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.controller import (
@@ -38,7 +36,13 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
-from predictionio_tpu.ops.topk import host_top_k
+from predictionio_tpu.retrieval import (
+    IVFIndex,
+    Retriever,
+    build_train_index,
+    cached_retriever,
+    iter_hits,
+)
 
 __all__ = [
     "engine",
@@ -225,35 +229,65 @@ class ALSAlgorithmParams(Params):
     gatherWindow: Union[bool, str] = "auto"  # noqa: N815
 
 
-@dataclasses.dataclass
+# eq=False: wrapper identity IS the model generation — keeps the object
+# hashable for the weak-keyed retriever cache.
+@dataclasses.dataclass(eq=False)
 class ALSModelWrapper:
-    """Trained factors + indexes (reference: template ALSModel)."""
+    """Trained factors + indexes (reference: template ALSModel).
+
+    ``ivf`` is the optional train-time coarse index (ISSUE 8) — it rides
+    INSIDE this pickle, so the staged-reload/rollback generation swap
+    moves model and index as one artifact: a rollback can never serve
+    generation-N factors through a generation-N+1 index (the retrieval
+    facade's fingerprint check makes any future violation loud).
+    """
 
     model: als_lib.ALSModel
     user_index: BiMap
     item_index: BiMap
+    ivf: Optional[IVFIndex] = None
     # Host-resident factor copies for the serving fast path: a B=1
     # predict is ~N·K MACs — orders of magnitude below one device
     # dispatch round-trip — so small batches are answered in numpy from
     # these (pulled once, lazily).  None until first host predict.
     _host: Optional[Tuple[np.ndarray, np.ndarray]] = None
-    # (padded item factors, padding-mask bias) for the chunked MIPS path
-    # (built once, reused across requests).  None until first chunked
-    # predict.
-    _chunk_padded: Optional[Tuple[jax.Array, jax.Array]] = None
-    # jitted device MIPS callables keyed by (kind, batch, k): the hot
-    # path must be ONE cached dispatch — a fresh closure per request
-    # would re-trace and pay several eager round-trips instead.
-    _mips_jit: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
+    _host_uf: Optional[np.ndarray] = None
 
     def __getstate__(self):
-        # serving caches are transient (jitted callables and padded
-        # device copies don't pickle, and a reloaded model rebuilds them)
+        # serving caches are transient (a reloaded model rebuilds them;
+        # the per-generation Retriever lives in retrieval.cached_retriever
+        # keyed weakly on this object, so it never rides the pickle)
         d = self.__dict__.copy()
         d["_host"] = None
-        d["_chunk_padded"] = None
-        d["_mips_jit"] = {}
+        d["_host_uf"] = None
         return d
+
+    def retriever(self) -> Retriever:
+        """THE serving route to the item corpus (retrieval facade):
+        host/device/chunked/sharded/IVF routing, jit caches, metrics —
+        one per loaded generation, dying with it."""
+        # host_fn must hold the wrapper WEAKLY: the retriever is the
+        # weak-keyed cache's VALUE, so a strong self capture would pin
+        # its own key alive and leak every swapped-out generation.  It
+        # is only ever called through a live wrapper's retriever().
+        ref = weakref.ref(self)
+        return cached_retriever(self, lambda: Retriever(
+            self.model.item_factors,
+            n_items=len(self.item_index),
+            ivf=getattr(self, "ivf", None),
+            name="als",
+            host_fn=lambda: ref().host_factors()[1]))
+
+    def host_user_factors(self) -> np.ndarray:
+        """User factors only — batch_predict needs just the query rows;
+        pulling host_factors() there would device_get and retain the
+        FULL item matrix even when a device rung serves the corpus."""
+        if self._host is not None:
+            return self._host[0]
+        if self._host_uf is None:
+            uf = jax.device_get(self.model.user_factors)
+            self._host_uf = np.asarray(uf)[: len(self.user_index)]
+        return self._host_uf
 
     def host_factors(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._host is None:
@@ -269,41 +303,28 @@ class ALSModelWrapper:
         """Serving-time re-parallelization (reference: SURVEY §3.2, P
         models re-parallelize in CreateServer): with a serving mesh and
         a corpus above ``PIO_SERVE_SHARD_ABOVE`` items, row-shard the
-        reloaded factors over the ``data`` axis so predict routes
-        through ``ops.topk.sharded_top_k`` — per-chip memory and score
-        work scale 1/n_chips for corpora that outgrow one chip."""
+        item matrix over the ``data`` axis at model-load time — the
+        facade's :meth:`~predictionio_tpu.retrieval.Retriever.maybe_shard`
+        pads host-side and stages shard-by-shard, and predict then
+        routes through the mesh-sharded exact rung (per-chip memory and
+        score work scale 1/n_chips)."""
         mesh = getattr(ctx, "mesh", None)
         if mesh is None:
             return
-        from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
-        if AXIS_DATA not in mesh.shape:
-            return
-        above = int(os.environ.get("PIO_SERVE_SHARD_ABOVE", 1_000_000))
-        itf = self.model.item_factors
-        if itf.shape[0] <= above:
-            return
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        r = self.retriever()
+        if r.maybe_shard(mesh):
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-        d = mesh.shape[AXIS_DATA]
-        pad = (-itf.shape[0]) % d
-        # pad HOST-side: a jnp.pad would stage the full corpus on one
-        # device first — OOM at exactly the scale this hook targets;
-        # put_sharded device_puts the numpy array shard-by-shard
-        itf_h = np.pad(np.asarray(jax.device_get(itf)), ((0, pad), (0, 0)))
-        self.model.item_factors = put_sharded(
-            itf_h, mesh, NamedSharding(mesh, P(AXIS_DATA, None)))
-        # queries gather a handful of user rows per request — replicated
-        self.model.user_factors = put_sharded(
-            np.asarray(jax.device_get(self.model.user_factors)), mesh,
-            NamedSharding(mesh, P()))
+            from predictionio_tpu.parallel.mesh import put_sharded
 
-
-# Guards cold-path serving cache builds (padded corpus copy, jit
-# compiles): a burst of concurrent first requests on the threaded server
-# must not each materialize its own 512 MB+ padded corpus.  One process-
-# wide lock — builds are rare (first request per layout) and short
-# relative to the HBM spike they prevent.
-_serve_cache_lock = threading.Lock()
+            # Sync the wrapper's reference to the facade's sharded copy
+            # so the pre-shard whole-corpus device array can be freed.
+            self.model.item_factors = r.vecs
+            # queries gather a handful of user rows per request —
+            # replicated
+            self.model.user_factors = put_sharded(
+                np.asarray(jax.device_get(self.model.user_factors)),
+                mesh, NamedSharding(mesh, P()))
 
 
 class ALSAlgorithm(Algorithm):
@@ -343,135 +364,49 @@ class ALSAlgorithm(Algorithm):
             checkpoint_dir=(os.path.join(ck_dir, "als") if ck_dir else None),
             save_every=ck_every,
         )
+        itf_host = np.asarray(
+            jax.device_get(model.item_factors))[: len(prepared_data.item_index)]
         return ALSModelWrapper(
             model=model,
             user_index=prepared_data.user_index,
             item_index=prepared_data.item_index,
+            # Train-time coarse index — serialized with the model so the
+            # generation swap moves both atomically.  Raw ALS factors
+            # carry popularity-scaled norms (a poor IVF fit: cells
+            # partition by direction), so the index builds only under an
+            # explicit PIO_IVF=on, never auto.
+            ivf=build_train_index(itf_host, name="als", seed=cfg.seed,
+                                  require_explicit=True),
         )
 
     def predict(self, model: ALSModelWrapper, query: Query) -> PredictedResult:
-        # One query = a batch of one: the same host-vs-device routing
-        # (MACs threshold, sharded/chunked device paths) applies, so a
+        # One query = a batch of one: the same facade routing (host MACs
+        # threshold, sharded/chunked/IVF device paths) applies, so a
         # corpus that outgrew the host fast path serves B=1 correctly too.
         return self.batch_predict(model, [(0, query)])[0][1]
 
-    def _device_top_k(self, model: ALSModelWrapper, idxs, k: int):
-        """Device MIPS over the item corpus, one dispatch, shape-stable.
-
-        Routing (SURVEY §7 "serving latency"): a model whose item
-        factors are row-sharded on a mesh serves via
-        ``ops.topk.sharded_top_k`` (per-shard scoring, O(k·shards·B)
-        ICI traffic); an unsharded corpus above
-        ``PIO_SERVE_CHUNK_ABOVE`` items scores in ``chunked_top_k``
-        slabs so the [B, N] score block never materializes; small
-        corpora take the plain one-matmul path.  Batch pads to the
-        next power of two so only a handful of XLA programs compile
-        (continuous batching with a compiled batch-size menu).
-        """
-        from jax.sharding import NamedSharding
-
-        from predictionio_tpu.ops.topk import chunked_top_k, sharded_top_k
-
-        b = 1 << (len(idxs) - 1).bit_length()  # next pow2: 1/2/4/8/...
-        uidx = jnp.asarray(list(idxs) + [0] * (b - len(idxs)))
-        itf = model.model.item_factors
-        n_items = len(model.item_index)
-        sh = getattr(itf, "sharding", None)
-        if isinstance(sh, NamedSharding) and sh.spec and sh.spec[0] \
-                and itf.shape[0] % sh.mesh.shape[sh.spec[0]] == 0:
-            fn = model._mips_jit.get(("sharded", b, k))
-            if fn is None:
-                with _serve_cache_lock:
-                    fn = model._mips_jit.get(("sharded", b, k))
-                    if fn is None:
-                        mesh, axis = sh.mesh, sh.spec[0]
-
-                        def _sharded(uf, itf, uidx):
-                            return sharded_top_k(mesh, axis, uf[uidx], itf,
-                                                 k, n_valid=n_items)
-
-                        fn = jax.jit(_sharded)
-                        model._mips_jit[("sharded", b, k)] = fn
-            return fn(model.model.user_factors, itf, uidx)
-        chunk_above = int(os.environ.get("PIO_SERVE_CHUNK_ABOVE",
-                                         2_000_000))
-        if n_items > chunk_above:
-            from predictionio_tpu.ops.topk import NEG_INF
-
-            chunk = 262_144
-
-            def _stale(c):
-                return c is None or c[0].shape[0] != \
-                    itf.shape[0] + (-itf.shape[0]) % chunk
-
-            if _stale(model._chunk_padded):
-                with _serve_cache_lock:
-                    if _stale(model._chunk_padded):
-                        pad = (-itf.shape[0]) % chunk
-                        itf_p = jnp.pad(itf, ((0, pad), (0, 0))) \
-                            if pad else itf
-                        # padding-row mask built ONCE with the padded
-                        # factors — rebuilding the [N] bias per request
-                        # would upload ~8 MB on the serving hot path
-                        bias = jnp.where(
-                            jnp.arange(itf_p.shape[0]) < n_items,
-                            jnp.float32(0.0), NEG_INF)
-                        # ONE corpus copy on device: the padded array
-                        # serves every path from here (host_factors trims
-                        # by len(item_index))
-                        model.model.item_factors = itf_p
-                        model._chunk_padded = (itf_p, bias)
-            itf_p, bias = model._chunk_padded
-            fn = model._mips_jit.get(("chunked", b, k))
-            if fn is None:
-                with _serve_cache_lock:
-                    fn = model._mips_jit.get(("chunked", b, k))
-                    if fn is None:
-                        def _chunked(uf, itf_p, bias, uidx):
-                            return chunked_top_k(uf[uidx], itf_p, k,
-                                                 chunk=chunk, biases=bias)
-
-                        fn = jax.jit(_chunked)
-                        model._mips_jit[("chunked", b, k)] = fn
-            return fn(model.model.user_factors, itf_p, bias, uidx)
-        return als_lib.recommend(model.model, uidx, k)
-
     def batch_predict(self, model: ALSModelWrapper, queries):
-        """Vectorized eval/serving path: one batched matmul for all queries.
+        """Vectorized eval/serving path — ONE retrieval-facade call for
+        the whole cohort.
 
-        The user batch is padded to the next power of two and ``num`` to a
-        small menu of K values so only a handful of XLA programs ever
-        compile (SURVEY.md §7: continuous batching with a few compiled
-        batch sizes) — without this, every distinct batch size arriving
-        from the serving frontend triggers a fresh compile.
+        All routing (host fast path under ``PIO_SERVE_HOST_MACS``,
+        mesh-sharded / chunked device scoring, the train-time IVF index,
+        pow2 batch + K-menu compile discipline) lives in
+        :mod:`predictionio_tpu.retrieval` — this template only maps ids.
         """
         known = [(i, q) for i, q in queries if q.user in model.user_index]
         out = [(i, PredictedResult(itemScores=[])) for i, q in queries
                if q.user not in model.user_index]
         if known:
             num = max(q.num for _, q in known)
-            idxs = [model.user_index[q.user] for _, q in known]
-            k_menu = (1, 10, 100, 1000)
-            k = min(len(model.item_index),
-                    next((m for m in k_menu if m >= num), num))
-            # Host when the batch matmul is small (one device dispatch
-            # round-trip costs more than ~1e8 host MACs); device for big
-            # sweeps (batch eval over the full catalog, 1M+ corpora).
-            work = len(idxs) * len(model.item_index) * model.model.rank
-            if work <= int(os.environ.get("PIO_SERVE_HOST_MACS", 2 * 10**8)):
-                uf, itf = model.host_factors()
-                scores, ids = host_top_k(uf[np.asarray(idxs)], itf, k)
-            else:
-                scores, ids = self._device_top_k(model, idxs, k)
-                # ONE host transfer for the whole batch — per-row
-                # np.asarray would round-trip the device per request.
-                scores, ids = jax.device_get((scores, ids))
+            idxs = np.asarray([model.user_index[q.user] for _, q in known])
+            uf = model.host_user_factors()
+            scores, ids, _info = model.retriever().topk(uf[idxs], num)
             inv = model.item_index.inverse
             for row, (i, q) in enumerate(known):
                 out.append((i, PredictedResult(itemScores=[
-                    ItemScore(item=inv[int(ii)], score=float(ss))
-                    for ss, ii in zip(scores[row][: q.num],
-                                      ids[row][: q.num])
+                    ItemScore(item=inv[ii], score=ss)
+                    for ii, ss in iter_hits(scores[row], ids[row], q.num)
                 ])))
         return out
 

@@ -35,7 +35,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
-from predictionio_tpu.ops.topk import host_top_k
+from predictionio_tpu.retrieval import Retriever, cached_retriever, iter_hits
 
 __all__ = [
     "Query", "ItemScore", "PredictedResult", "ViewData", "DataSourceParams",
@@ -119,11 +119,19 @@ class ALSAlgorithmParams(Params):
     seed: Optional[int] = None
 
 
-@dataclasses.dataclass
+# eq=False: wrapper identity IS the model generation (weak-keyed
+# retriever cache needs a hashable owner).
+@dataclasses.dataclass(eq=False)
 class SimilarProductModel:
     item_factors: np.ndarray       # [I, K] L2-normalized
     item_index: BiMap
     item_categories: Dict[str, Set[str]]
+
+    def retriever(self) -> Retriever:
+        """THE serving route to the item corpus (retrieval facade)."""
+        return cached_retriever(self, lambda: Retriever(
+            self.item_factors, n_items=len(self.item_index),
+            name="similarproduct"))
 
 
 class ALSAlgorithm(Algorithm):
@@ -186,14 +194,14 @@ class ALSAlgorithm(Algorithm):
                 if i in model.item_index:
                     exclude[0, model.item_index[i]] = True
 
-        k = min(query.num, n_items)
-        scores, ids = host_top_k(q, f, k, exclude=exclude)
-        out = []
-        for s, i in zip(scores[0], ids[0]):
-            if s <= -1e37:  # ran out of unmasked candidates
-                break
-            out.append(ItemScore(item=inv[int(i)], score=float(s)))
-        return PredictedResult(itemScores=out)
+        # Facade retrieval with the per-request exclude mask: the
+        # planner pins exclude-carrying queries to the exact rungs (an
+        # excluded id must never cost recall like an unprobed IVF cell).
+        scores, ids, _info = model.retriever().topk(q, query.num,
+                                                    exclude=exclude)
+        return PredictedResult(itemScores=[
+            ItemScore(item=inv[i], score=s)
+            for i, s in iter_hits(scores[0], ids[0], query.num)])
 
 
 def engine() -> Engine:

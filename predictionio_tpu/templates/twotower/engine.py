@@ -15,9 +15,8 @@ DP over the ``data`` mesh axis, MIPS top-K serve.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -32,7 +31,13 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import two_tower as tt_lib
-from predictionio_tpu.ops.topk import top_k_scores
+from predictionio_tpu.retrieval import (
+    IVFIndex,
+    Retriever,
+    build_train_index,
+    cached_retriever,
+    iter_hits,
+)
 
 __all__ = [
     "Query", "ItemScore", "PredictedResult", "InteractionData",
@@ -105,14 +110,44 @@ class TwoTowerAlgorithmParams(Params):
     seed: Optional[int] = None
 
 
-@dataclasses.dataclass
+# eq=False: wrapper identity IS the model generation — keeps the object
+# hashable for the weak-keyed retriever cache.
+@dataclasses.dataclass(eq=False)
 class TwoTowerModelWrapper:
-    """Precomputed encoded item corpus + user embeddings for serving."""
+    """Precomputed encoded item corpus + user embeddings for serving.
+
+    ``ivf`` is the optional train-time coarse index (ISSUE 8).  It rides
+    INSIDE this pickle, so the staged-reload/rollback generation swap
+    moves model and index as one artifact — a rollback can never serve
+    generation-N vectors through a generation-N+1 index (the retrieval
+    facade's corpus fingerprint check makes any future violation loud).
+    """
 
     user_vecs: np.ndarray   # [U, D] — encoded user representations
-    item_vecs: np.ndarray   # [I, D]
+    item_vecs: np.ndarray   # [I, D] (L2-normalized tower outputs)
     user_index: BiMap
     item_index: BiMap
+    ivf: Optional[IVFIndex] = None
+
+    def retriever(self) -> Retriever:
+        """THE serving route to the item corpus (retrieval facade):
+        host/device/chunked/sharded/IVF routing, jit caches, metrics —
+        one per loaded generation, dying with it."""
+        return cached_retriever(self, lambda: Retriever(
+            self.item_vecs,
+            n_items=len(self.item_index),
+            ivf=getattr(self, "ivf", None),
+            name="twotower"))
+
+    def post_load(self, ctx) -> None:
+        """Serving-time re-parallelization: with a serving mesh and a
+        corpus above ``PIO_SERVE_SHARD_ABOVE`` items, row-shard the item
+        matrix over the ``data`` axis at model-load time so predict
+        routes through the mesh-sharded exact rung — per-chip memory and
+        score work scale 1/n_chips for corpora that outgrow one chip."""
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is not None:
+            self.retriever().maybe_shard(mesh)
 
 
 class TwoTowerAlgorithm(Algorithm):
@@ -143,29 +178,29 @@ class TwoTowerAlgorithm(Algorithm):
         return TwoTowerModelWrapper(
             user_vecs=user_vecs, item_vecs=item_vecs,
             user_index=prepared_data.user_index,
-            item_index=prepared_data.item_index)
+            item_index=prepared_data.item_index,
+            # Train-time coarse index (policy-gated: PIO_IVF /
+            # PIO_IVF_MIN_ITEMS) — the normalized tower outputs are the
+            # IVF design target; serialized with the model so the
+            # generation swap moves both atomically.
+            ivf=build_train_index(item_vecs, name="twotower",
+                                  seed=cfg.seed))
 
     def predict(self, model: TwoTowerModelWrapper, query: Query) -> PredictedResult:
-        uidx = model.user_index.get(query.user)
-        if uidx is None:
-            return PredictedResult(itemScores=[])
-        q = jnp.asarray(model.user_vecs[uidx][None, :])
-        k = min(query.num, model.item_vecs.shape[0])
-        scores, ids = top_k_scores(q, jnp.asarray(model.item_vecs), k)
-        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
-        inv = model.item_index.inverse
-        return PredictedResult(itemScores=[
-            ItemScore(item=inv[int(i)], score=float(s))
-            for s, i in zip(scores[0], ids[0])])
+        # A batch of one: the facade's host fast path answers a lone
+        # client in numpy (a B=1 matmul is orders of magnitude below one
+        # device dispatch round-trip) — the same PIO_SERVE_HOST_MACS
+        # threshold the ALS template uses, parity-tested.
+        return self.batch_predict(model, [(0, query)])[0][1]
 
     def batch_predict(self, model: TwoTowerModelWrapper, queries):
         """Vectorized serving path for the continuous-batching scheduler:
-        ONE ``top_k_scores`` dispatch for the whole cohort.
+        ONE retrieval-facade call for the whole cohort.
 
-        Batch and K are padded to small menus (powers of two / the ALS
-        template's K menu) so the serving frontend's varying batch sizes
-        hit a handful of compiled XLA programs instead of compiling per
-        distinct shape (SURVEY.md §7).
+        All routing (host fast path, mesh-sharded / chunked device
+        scoring, the train-time IVF index, pow2 batch + K-menu compile
+        discipline) lives in :mod:`predictionio_tpu.retrieval` — this
+        template only maps ids.
         """
         known = [(i, q) for i, q in queries
                  if model.user_index.get(q.user) is not None]
@@ -173,25 +208,15 @@ class TwoTowerAlgorithm(Algorithm):
                if model.user_index.get(q.user) is None]
         if not known:
             return out
-        n_items = model.item_vecs.shape[0]
         num = max(q.num for _, q in known)
-        k_menu = (1, 10, 100, 1000)
-        k = min(n_items, next((m for m in k_menu if m >= num), num))
         idxs = np.asarray([model.user_index[q.user] for _, q in known])
-        qvecs = model.user_vecs[idxs]
-        pad = (1 << max(len(idxs) - 1, 0).bit_length()) - len(idxs)
-        if pad:
-            qvecs = np.concatenate(
-                [qvecs, np.zeros((pad, qvecs.shape[1]), qvecs.dtype)])
-        scores, ids = top_k_scores(
-            jnp.asarray(qvecs), jnp.asarray(model.item_vecs), k)
-        scores, ids = jax.device_get((scores, ids))  # ONE host transfer
+        scores, ids, _info = model.retriever().topk(
+            model.user_vecs[idxs], num)
         inv = model.item_index.inverse
         for row, (i, q) in enumerate(known):
-            kk = min(q.num, n_items)
             out.append((i, PredictedResult(itemScores=[
-                ItemScore(item=inv[int(ii)], score=float(ss))
-                for ss, ii in zip(scores[row][:kk], ids[row][:kk])])))
+                ItemScore(item=inv[ii], score=ss)
+                for ii, ss in iter_hits(scores[row], ids[row], q.num)])))
         return out
 
 

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Static check: serving reaches the item corpus ONLY via the facade.
+
+ISSUE 8 built ``predictionio_tpu/retrieval/`` — host/device/chunked/
+mesh-sharded/IVF routing, per-generation jit+staging caches, retrieval
+metrics, and the IVF generation-fingerprint tripwire — and rewired every
+template's serving path through it.  That consolidation only stays true
+if nothing regresses it: a NEW template (or a refactor) that calls
+``ops.topk.top_k_scores`` directly silently forfeits the host fast path,
+the compiled-program menu, corpus staging reuse, IVF, sharding, AND the
+``pio_retrieval_*`` metrics — and re-grows the per-template routing
+forks this PR deleted.  This lint locks the invariant in (same pattern
+as ``tools/lint_dispatch.py``; a tier-1 test runs it in CI):
+
+1. No module under ``predictionio_tpu/templates/``, ``server/``, or
+   ``serving/`` may import ``predictionio_tpu.ops.topk`` or
+   ``predictionio_tpu.ops.pallas_kernels`` (the raw primitives are
+   facade internals there).
+2. No such module may CALL a retrieval primitive —
+   ``top_k_scores`` / ``chunked_top_k`` / ``sharded_top_k`` /
+   ``host_top_k`` / ``fused_topk`` / ``fused_topk_pallas`` — by any
+   name-or-attribute spelling.
+3. Every ``templates/*/engine.py`` that uses the facade's
+   :class:`Retriever` must hold it via ``cached_retriever`` (the
+   weak-keyed per-generation cache): constructing ``Retriever(...)``
+   outside a ``cached_retriever`` build lambda re-stages corpus copies
+   and re-traces jit programs per call site.
+
+The allowed homes of the primitives stay ``predictionio_tpu/retrieval/``
+and ``predictionio_tpu/ops/`` (and ``models/``, which are training-side
+substrate, not serving handlers).
+
+Usage: ``python tools/lint_retrieval.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# Directories whose modules are the serving surface (rule scope).
+_SCOPES = ("templates", "server", "serving")
+# Modules that are facade internals — importing them from the serving
+# surface is rule 1's violation.
+_BANNED_MODULES = ("predictionio_tpu.ops.topk",
+                   "predictionio_tpu.ops.pallas_kernels")
+# The retrieval primitives themselves (rule 2) — any call spelled
+# ``name(...)`` or ``<anything>.name(...)``.
+_PRIMITIVES = {"top_k_scores", "chunked_top_k", "sharded_top_k",
+               "host_top_k", "fused_topk", "fused_topk_pallas"}
+
+
+def _import_violations(tree: ast.AST, filename: str) -> List[str]:
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in _BANNED_MODULES or any(
+                        alias.name.startswith(m + ".")
+                        for m in _BANNED_MODULES):
+                    out.append(
+                        f"{filename}:{node.lineno}: imports {alias.name} — "
+                        f"serving reaches the corpus via "
+                        f"predictionio_tpu.retrieval, never the raw ops")
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in _BANNED_MODULES or any(
+                    mod.startswith(m + ".") for m in _BANNED_MODULES):
+                names = ", ".join(a.name for a in node.names)
+                out.append(
+                    f"{filename}:{node.lineno}: imports {names} from "
+                    f"{mod} — serving reaches the corpus via "
+                    f"predictionio_tpu.retrieval, never the raw ops")
+    return out
+
+
+def _call_violations(tree: ast.AST, filename: str) -> List[str]:
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if name in _PRIMITIVES:
+            out.append(
+                f"{filename}:{node.lineno}: calls {name}() directly — "
+                f"route through Retriever.topk (predictionio_tpu."
+                f"retrieval) so the request gets routing, staging "
+                f"caches, IVF, and pio_retrieval_* metrics")
+    return out
+
+
+def _raw_retriever_violations(tree: ast.AST, filename: str) -> List[str]:
+    """Rule 3: ``Retriever(...)`` constructions outside a
+    ``cached_retriever`` call's argument lambda."""
+    inside_cached: set = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "cached_retriever"):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    inside_cached.add(id(sub))
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Retriever"
+                and id(node) not in inside_cached):
+            out.append(
+                f"{filename}:{node.lineno}: constructs Retriever() "
+                f"outside cached_retriever — a fresh retriever per call "
+                f"re-stages the corpus and re-traces its jit programs; "
+                f"build it inside cached_retriever(owner, lambda: ...)")
+    return out
+
+
+def check_source(source: str, filename: str,
+                 engine_module: bool = False) -> List[str]:
+    """Violations in one module's source (path:line prefixed strings)."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    violations = _import_violations(tree, filename)
+    violations += _call_violations(tree, filename)
+    if engine_module:
+        violations += _raw_retriever_violations(tree, filename)
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    """Violations across the serving surface under ``root``."""
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    pkg = root / "predictionio_tpu"
+    violations: List[str] = []
+    for scope in _SCOPES:
+        for path in sorted((pkg / scope).rglob("*.py")):
+            violations.extend(check_source(
+                path.read_text(encoding="utf-8"), str(path),
+                engine_module=(scope == "templates"
+                               and path.name == "engine.py")))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} retrieval-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_retrieval: serving reaches the corpus via the retrieval "
+          "facade only.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -402,9 +402,21 @@ def _drive_level(port: int, n_users: int, clients: int, requests: int,
                     if not part:
                         raise OSError("closed")
                     buf += part
+                # Server-attested wall (X-PIO-Server-Ms): the waterfall
+                # stage sum is reconciled against its p50 (ISSUE 9).
+                j = head.find(b"x-pio-server-ms:")
+                server_ms = None
+                if j >= 0:
+                    jstop = head.find(b"\r", j)
+                    try:
+                        server_ms = float(
+                            head[j + 16:jstop if jstop > 0 else None])
+                    except ValueError:
+                        pass
                 ms = (time.perf_counter() - t0) * 1e3
                 with lock:
-                    outcomes.append((status, ms, budget_ms, remaining_ms))
+                    outcomes.append((status, ms, budget_ms, remaining_ms,
+                                     server_ms))
                 return
             except (OSError, ValueError):
                 try:
@@ -426,23 +438,25 @@ def _drive_level(port: int, n_users: int, clients: int, requests: int,
     with concurrent.futures.ThreadPoolExecutor(clients) as ex:
         list(ex.map(one, reqs))
     wall = time.perf_counter() - t0
-    ok = np.array([ms for s, ms, _, _ in outcomes if s == 200])
+    ok = np.array([ms for s, ms, _, _, _ in outcomes if s == 200])
     statuses = {}
-    for s, _, _, _ in outcomes:
+    for s, _, _, _, _ in outcomes:
         statuses[str(s)] = statuses.get(str(s), 0) + 1
-    sent_tight = sum(1 for _, _, b, _ in outcomes if b < 1000)
-    shed_504 = sum(1 for s, _, _, _ in outcomes if s == 504)
+    sent_tight = sum(1 for _, _, b, _, _ in outcomes if b < 1000)
+    shed_504 = sum(1 for s, _, _, _, _ in outcomes if s == 504)
     # served_late_200: the server ATTESTS (X-PIO-Deadline-Remaining-Ms)
     # its budget was already spent yet it answered 200 anyway — must be
     # 0 (the transport's late-response shed makes this structural).
     # client_over_budget_200 additionally counts transport queueing the
     # deadline header doesn't cover (context, not a violation).
     served_late = sum(
-        1 for s, _, _, rem in outcomes
+        1 for s, _, _, rem, _ in outcomes
         if s == 200 and rem is not None and rem < 0)
     client_over = sum(
-        1 for s, ms, b, _ in outcomes
+        1 for s, ms, b, _, _ in outcomes
         if s == 200 and ms > b + _VIOLATION_GRACE_MS)
+    attested = sorted(sm for s, _, _, _, sm in outcomes
+                      if s == 200 and sm is not None)
     def _pct(p):
         # A level can come back with ZERO 200s (100% fault plans): the
         # record says so via null percentiles, not a percentile crash.
@@ -453,11 +467,54 @@ def _drive_level(port: int, n_users: int, clients: int, requests: int,
         "p50_ms": _pct(50),
         "p95_ms": _pct(95),
         "p99_ms": _pct(99),
+        # server-attested wall p50: the waterfall reconciliation anchor
+        "server_ms_p50": (round(attested[len(attested) // 2], 2)
+                          if attested else None),
         "statuses": statuses,
         "deadlines": {"tight_sent": sent_tight, "shed_504": shed_504,
                       "served_late_200": served_late,
                       "client_over_budget_200": client_over},
     }
+
+
+def _waterfall_for_level(log_path: str, offset: int, server_ms_p50):
+    """Per-stage attribution for the rows the level appended to the
+    PIO_REQUEST_LOG wide-event JSONL (ISSUE 9): mean/p50 per stage, the
+    dominant stage + recommended attack, and the acceptance
+    reconciliation — waterfall stage sum vs the SERVER-ATTESTED
+    X-PIO-Server-Ms wall, both at p50 (must agree within 10%)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+    import attribute_serve
+
+    # The JSONL line lands after the response bytes reach the client, so
+    # poll until the tail stops growing (the slowest handler threads may
+    # still be writing their finalize lines).
+    deadline = time.monotonic() + 2.0
+    text, last_len = "", -1
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path, encoding="utf-8") as f:
+                f.seek(offset)
+                text = f.read()
+        except OSError:
+            return None
+        if len(text) == last_len:
+            break
+        last_len = len(text)
+        time.sleep(0.05)
+    rows = attribute_serve.parse_request_log(text)
+    if not rows:
+        return None
+    out = attribute_serve.attribute_log(rows)
+    if server_ms_p50 and out.get("reconciliation"):
+        # Cross-check: the CLIENT-observed X-PIO-Server-Ms p50 should
+        # match the serverMs the wide events recorded themselves.
+        out["reconciliation"]["client_observed_server_p50_ms"] = \
+            server_ms_p50
+    return out
 
 
 def _sweep(args) -> None:
@@ -468,16 +525,33 @@ def _sweep(args) -> None:
     eng, variant, storage, n_users = _setup(args.engine)
     record = {"mode": "sweep", "engine": args.engine, "levels": levels,
               "requests_per_level": args.requests, "rounds": {}}
+    # Per-request wide events (ISSUE 9): every level's rows feed the
+    # per-stage waterfall block next to the client percentiles.
+    request_log = os.environ.setdefault(
+        "PIO_REQUEST_LOG",
+        os.path.join(tempfile.mkdtemp(prefix="pio_bench_"),
+                     "requests.jsonl"))
+
+    def _log_offset():
+        try:
+            return os.path.getsize(request_log)
+        except OSError:
+            return 0
 
     srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
     srv.start()
     batched = []
     for lvl in levels:
         before = _scrape_batcher(srv.port)
-        res = _drive_level(srv.port, n_users, lvl, args.requests)
+        marks = {}
+        res = _drive_level(srv.port, n_users, lvl, args.requests,
+                           on_warm=lambda: marks.setdefault(
+                               "offset", _log_offset()))
         res["scheduler"] = _batcher_delta(before, _scrape_batcher(srv.port))
         res["knobs"] = {k: srv.scheduler.snapshot()["default"][k]
                         for k in ("windowMs", "maxBatch")}
+        res["waterfall"] = _waterfall_for_level(
+            request_log, marks.get("offset", 0), res.get("server_ms_p50"))
         batched.append({"concurrency": lvl, **res})
         print(json.dumps({"round": "batched", "concurrency": lvl, **res}))
     record["rounds"]["clean_batched"] = batched
@@ -504,7 +578,12 @@ def _sweep(args) -> None:
     srv.start()
     unbatched = []
     for lvl in levels:
-        res = _drive_level(srv.port, n_users, lvl, args.requests)
+        marks = {}
+        res = _drive_level(srv.port, n_users, lvl, args.requests,
+                           on_warm=lambda: marks.setdefault(
+                               "offset", _log_offset()))
+        res["waterfall"] = _waterfall_for_level(
+            request_log, marks.get("offset", 0), res.get("server_ms_p50"))
         unbatched.append({"concurrency": lvl, **res})
         print(json.dumps({"round": "unbatched", "concurrency": lvl,
                           **res}))
@@ -515,6 +594,10 @@ def _sweep(args) -> None:
         if b["p99_ms"] is not None and u["p99_ms"] is not None:
             b["p99_vs_unbatched_ms"] = round(b["p99_ms"] - u["p99_ms"], 2)
     print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.out}")
 
 
 # --------------------------------------------------------------------------

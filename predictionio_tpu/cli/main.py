@@ -29,6 +29,7 @@ import datetime as _dt
 import os
 import json
 import logging
+import re
 import sys
 from pathlib import Path
 from typing import List, Optional
@@ -75,9 +76,67 @@ def cmd_status(args) -> int:
         _print_device_memory()
     except Exception as e:  # TPU tunnel may be down; status should still work
         print(f"devices: unavailable ({e})")
-    _print_metrics_snapshot(getattr(args, "metrics_url", None))
+    fleet = getattr(args, "fleet", None)
+    metrics_url = getattr(args, "metrics_url", None)
+    # An explicit --metrics-url outranks the ambient PIO_FLEET_INSTANCES:
+    # the operator asked about ONE process, not the fleet the env
+    # happens to describe.  --fleet (also explicit) still wins over it.
+    if fleet is not None or (metrics_url is None
+                             and os.environ.get("PIO_FLEET_INSTANCES")):
+        _print_fleet_status(fleet)
+    else:
+        _print_metrics_snapshot(metrics_url)
     print("(sanity check OK)")
     return 0
+
+
+def _print_fleet_status(fleet_arg: Optional[str]) -> None:
+    """`pio status --fleet URL,URL` (ISSUE 9): scrape every instance's
+    /metrics + SLO state, merge type-correctly (obs.fleet), and print
+    the operator summary — per-instance readiness next to fleet-summed
+    traffic counters."""
+    from predictionio_tpu.obs.fleet import (
+        FleetAggregator,
+        fleet_instances_from_env,
+    )
+
+    urls = ([u.strip().rstrip("/") for u in fleet_arg.split(",")
+             if u.strip()] if fleet_arg else fleet_instances_from_env())
+    if not urls:
+        print("fleet: no instances configured (--fleet URL,URL or "
+              "PIO_FLEET_INSTANCES)")
+        return
+    agg = FleetAggregator(urls)
+    doc = agg.scrape()
+    print(f"fleet: {len(urls)} instance(s)")
+    for row in doc["instances"]:
+        state = "STALE" if row["stale"] else "up"
+        parts = [state]
+        slo = row.get("slo")
+        if slo:
+            parts.append("degraded" if slo.get("degraded") else "healthy")
+            if slo.get("saturated"):
+                parts.append("saturated")
+            fast = slo.get("burn", {}).get("fast", {})
+            parts.append(f"burn fast a={fast.get('availability', 0):g}"
+                         f"/l={fast.get('latency', 0):g}")
+        if row.get("error"):
+            parts.append(row["error"])
+        print(f"  {row['instance']}: {', '.join(parts)}")
+    counters = doc["merged"]["counters"]
+    interesting = ("pio_query_requests_total", "pio_query_errors_total",
+                   "pio_event_requests_total", "pio_queue_rejected_total",
+                   "pio_deadline_shed_total")
+    shown = {k: v for k, v in counters.items()
+             if any(k.startswith(p) for p in interesting)}
+    if shown:
+        print("  fleet totals:")
+        for k, v in sorted(shown.items()):
+            print(f"    {k} {v:g}")
+    q = doc["merged"]["histogramQuantiles"].get("pio_query_latency_ms", {})
+    for key, row in sorted(q.items()):
+        print(f"  fleet {key}: p50 {row['p50']:g}ms p99 {row['p99']:g}ms "
+              f"over {row['count']:g} requests")
 
 
 def _print_device_memory() -> None:
@@ -164,6 +223,9 @@ def _parse_metric_lines(lines):
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # Strip OpenMetrics exemplar suffixes (pio_serve_stage_ms buckets
+        # carry ` # {trace_id="..."} v` after the sample value).
+        line = line.split(" # ", 1)[0].rstrip()
         m = _METRIC_LINE.match(line)
         if not m:
             continue
@@ -816,12 +878,8 @@ def cmd_profile(args) -> int:
         from urllib.error import HTTPError
         from urllib.request import Request, urlopen
 
-        url = (args.url.rstrip("/")
-               + f"/admin/profile?duration_ms={duration_ms:g}")
-        if args.out:
-            from urllib.parse import quote
-
-            url += f"&out={quote(args.out)}"
+        base = args.url.rstrip("/")
+        url = base + f"/admin/profile?duration_ms={duration_ms:g}"
         try:
             with urlopen(Request(url, method="POST"), timeout=30) as resp:
                 body = json.loads(resp.read() or b"{}")
@@ -836,8 +894,42 @@ def cmd_profile(args) -> int:
             _die(f"cannot reach {args.url}: {e}")
         print(f"Profiling for {body.get('durationMs', duration_ms):g} ms; "
               f"artifacts: {body.get('path')}")
-        print("(view in TensorBoard/XProf or chrome://tracing once the "
-              "window closes)")
+        if not args.out:
+            print("(view in TensorBoard/XProf or chrome://tracing once "
+                  "the window closes; --out FILE downloads the archive)")
+            return 0
+        # ISSUE 9 satellite: the capture path above is SERVER-local —
+        # wait the window out, then pull the archive down over HTTP so
+        # remote/fleet operation never needs box access.
+        import time as _time
+
+        _time.sleep(float(body.get("durationMs", duration_ms)) / 1e3)
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            try:
+                with urlopen(base + "/admin/profile", timeout=10) as resp:
+                    if not json.loads(resp.read() or b"{}").get("active"):
+                        break
+            except OSError:
+                pass
+            _time.sleep(0.25)
+        try:
+            with urlopen(base + "/admin/profile/artifact",
+                         timeout=60) as resp:
+                data = resp.read()
+                disposition = resp.headers.get("Content-Disposition", "")
+        except HTTPError as e:
+            _die(f"artifact download failed: HTTP {e.code}")
+        except OSError as e:
+            _die(f"artifact download failed: {e}")
+        out = Path(args.out)
+        if out.is_dir():
+            # The server names the archive after its capture dir
+            # (Content-Disposition); fall back to a stable default.
+            m = re.search(r'filename="([^"/\\]+)"', disposition)
+            out = out / (m.group(1) if m else "pio_profile.tar.gz")
+        out.write_bytes(data)
+        print(f"Profile archive downloaded: {out} ({len(data):,} bytes)")
         return 0
     from predictionio_tpu.obs.profiler import ProfilerUnavailable, capture
 
@@ -854,7 +946,10 @@ def cmd_profile(args) -> int:
 def cmd_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import DashboardServer
 
-    srv = DashboardServer(storage=_storage(), host=args.ip, port=args.port)
+    fleet = ([u.strip() for u in args.fleet.split(",") if u.strip()]
+             if getattr(args, "fleet", None) else None)
+    srv = DashboardServer(storage=_storage(), host=args.ip, port=args.port,
+                          fleet=fleet)
     srv.start(block=False)
     print(f"Dashboard listening on {args.ip}:{srv.port} (Ctrl-C to stop)")
     try:
@@ -1048,6 +1143,12 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="URL",
                     help="scrape a running server's /metrics into the "
                          "status report (e.g. http://127.0.0.1:7070)")
+    st.add_argument("--fleet", dest="fleet", default=None,
+                    metavar="URLS",
+                    help="comma-separated instance base URLs (or unset: "
+                         "PIO_FLEET_INSTANCES) — scrape and merge "
+                         "/metrics + SLO state across the fleet instead "
+                         "of one process")
     st.set_defaults(fn=cmd_status)
 
     app = sub.add_parser("app", help="app management").add_subparsers(
@@ -1217,6 +1318,10 @@ def build_parser() -> argparse.ArgumentParser:
     db = sub.add_parser("dashboard", help="engine/evaluation instance dashboard")
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
+    db.add_argument("--fleet", default=None, metavar="URLS",
+                    help="comma-separated instance base URLs to aggregate "
+                         "at GET /fleet.json (default: "
+                         "PIO_FLEET_INSTANCES)")
     db.set_defaults(fn=cmd_dashboard)
 
     pf = sub.add_parser("profile", help="on-demand JAX profiler capture "
@@ -1228,8 +1333,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="admin server base URL (e.g. "
                          "http://127.0.0.1:7071) — capture happens there")
     pf.add_argument("--out", default=None,
-                    help="artifact directory (default: fresh temp dir; "
-                         "env PIO_PROFILE_OUT)")
+                    help="local capture: artifact directory (default: "
+                         "fresh temp dir; env PIO_PROFILE_OUT). With "
+                         "--url: LOCAL file/dir the capture archive is "
+                         "downloaded to after the window closes "
+                         "(GET /admin/profile/artifact)")
     pf.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("spill", help="inspect/drain the storage-outage "

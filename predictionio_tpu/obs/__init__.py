@@ -17,6 +17,14 @@ Three parts, one process-wide state:
 - :mod:`predictionio_tpu.obs.profiler` — on-demand bounded
   ``jax.profiler`` capture behind ``POST /admin/profile`` and
   ``pio profile``.
+- :mod:`predictionio_tpu.obs.waterfall` — per-request serving stage
+  decomposition (``pio_serve_stage_ms{stage}`` + exemplars + the
+  ``PIO_REQUEST_LOG`` wide-event JSONL).
+- :mod:`predictionio_tpu.obs.slo` — availability/latency SLOs,
+  multi-window burn rates, the ``/ready`` degradation verdict.
+- :mod:`predictionio_tpu.obs.fleet` — Prometheus-text parsing and the
+  type-correct multi-instance merge behind ``/fleet.json`` /
+  ``pio status --fleet``.
 
 stdlib-only on import: safe from the CLI, the servers, and the data layer
 without touching jax/numpy.
@@ -97,15 +105,18 @@ import contextlib as _contextlib
 
 
 @_contextlib.contextmanager
-def phase(name: str, *, metric: str = "pio_train_phase_ms", **attrs):
+def phase(name: str, **attrs):
     """Span + per-phase duration histogram in one context manager.
 
     The workflow's named phases (datasource / prepare / train / persist)
     show up both in the trace tree AND as ``pio_train_phase_ms{phase=...}``
     series, so a dashboard can watch phase drift without trace plumbing.
+    (The metric name is a literal by design — tools/lint_metrics.py
+    keeps every registered name statically checkable.)
     """
     hist = get_registry().histogram(
-        metric, "Workflow phase duration by phase name.", ("phase",))
+        "pio_train_phase_ms", "Workflow phase duration by phase name.",
+        ("phase",))
     with span(name, **attrs) as s:
         try:
             yield s
@@ -121,3 +132,8 @@ def reset_observability() -> None:
     get_registry().reset()
     get_recorder().clear()
     reset_runtime()
+    # A test that drives the engine's pio_handle directly (no transport
+    # driver) arms the request waterfall but nothing finalizes it — drop
+    # the leaked collector so the NEXT test's contextvar view is clean.
+    from predictionio_tpu.obs import waterfall as _waterfall
+    _waterfall.deactivate()

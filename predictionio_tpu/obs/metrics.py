@@ -81,6 +81,21 @@ def _label_pairs(labelnames: Sequence[str], labelvalues: Tuple[str, ...],
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
+def _fmt_exemplar(ex: Optional[Tuple[str, float]]) -> str:
+    """OpenMetrics exemplar suffix for a bucket line (empty when none).
+
+    Only rendered on the opt-in ``?exemplars=1`` view — classic 0.0.4
+    allows nothing but an optional timestamp after the value, so a
+    strict Prometheus scraper would reject an exposition carrying these.
+    Our own parsers (fleet aggregation, pio status, bench) strip the
+    suffix explicitly either way."""
+    if not ex:
+        return ""
+    trace_id, v = ex
+    return (f' # {{trace_id="{_escape_label_value(trace_id)}"}}'
+            f' {_fmt_value(v)}')
+
+
 class _Metric:
     """Shared base: name/help/labelnames validation + per-series storage."""
 
@@ -188,12 +203,15 @@ class Gauge(_Metric):
 
 
 class _HistSeries:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, value) of the LAST observation that
+        # landed there with an exemplar attached (OpenMetrics-style).
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
 
 
 class Histogram(_Metric):
@@ -213,7 +231,13 @@ class Histogram(_Metric):
         self.buckets = tuple(bs)
         self._series: Dict[Tuple[str, ...], _HistSeries] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation.  ``exemplar`` is an optional trace id
+        stored per (series, bucket) and rendered OpenMetrics-style after
+        the bucket line, linking the bucket to its ``/traces.json`` entry
+        (ISSUE 9 waterfall: "why is THIS bucket populated?" answers with
+        a concrete request to open)."""
         key = self._key(labels)
         v = float(value)
         with self._lock:
@@ -228,6 +252,18 @@ class Histogram(_Metric):
             s.counts[i] += 1
             s.sum += v
             s.count += 1
+            if exemplar:
+                s.exemplars[i] = (str(exemplar), v)
+
+    def exemplars(self, **labels) -> Dict[float, Tuple[str, float]]:
+        """{bucket_le: (trace_id, value)} for one series (+Inf = inf)."""
+        key = self._key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return {}
+            bounds = self.buckets + (math.inf,)
+            return {bounds[i]: ex for i, ex in s.exemplars.items()}
 
     def count(self, **labels) -> int:
         key = self._key(labels)
@@ -240,6 +276,32 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(key)
             return s.sum if s else 0.0
+
+    def count_le(self, value: float, **labels) -> float:
+        """Estimated observations ≤ ``value`` (linear interpolation inside
+        the containing bucket) — the latency-SLO "good events" reading.
+        Conservative at bucket edges exactly like :meth:`quantile`."""
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None or s.count == 0:
+                return 0.0
+            counts = list(s.counts)
+        cum = 0.0
+        lo = 0.0
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                if counts[j] and b > lo:
+                    frac = (v - lo) / (b - lo)
+                    cum += counts[j] * min(max(frac, 0.0), 1.0)
+                return cum
+            cum += counts[j]
+            lo = b
+        # Past the top finite bound: +Inf-bucket observations have no
+        # upper bound, so they count as NOT ≤ value (under-counts goods —
+        # the safe direction for an SLO).
+        return cum
 
     def quantile(self, q: float, **labels) -> float:
         """Bucket-interpolated quantile estimate (the /stats.json view).
@@ -292,23 +354,24 @@ class Histogram(_Metric):
             lo = b
         return self.buckets[-1]
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         with self._lock:
-            items = [(k, list(s.counts), s.sum, s.count)
+            items = [(k, list(s.counts), s.sum, s.count,
+                      dict(s.exemplars) if exemplars else {})
                      for k, s in sorted(self._series.items())]
         lines: List[str] = []
-        for key, counts, ssum, scount in items:
+        for key, counts, ssum, scount, exs in items:
             cum = 0
-            for b, c in zip(self.buckets, counts):
+            for j, (b, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 lines.append(
                     f"{self.name}_bucket"
                     f"{_label_pairs(self.labelnames, key, (('le', _fmt_value(b)),))}"
-                    f" {cum}")
+                    f" {cum}{_fmt_exemplar(exs.get(j))}")
             lines.append(
                 f"{self.name}_bucket"
                 f"{_label_pairs(self.labelnames, key, (('le', '+Inf'),))}"
-                f" {scount}")
+                f" {scount}{_fmt_exemplar(exs.get(len(self.buckets)))}")
             lines.append(f"{self.name}_sum"
                          f"{_label_pairs(self.labelnames, key)} "
                          f"{_fmt_value(ssum)}")
@@ -377,14 +440,22 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._metrics.values(), key=lambda m: m.name)
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4 for the whole process."""
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4 for the whole process.
+
+        ``exemplars=True`` appends OpenMetrics-style exemplar suffixes
+        to histogram bucket lines.  That syntax is NOT part of classic
+        0.0.4 — a strict Prometheus scraper rejects the whole exposition
+        over it — so the default render stays clean and the servers only
+        opt in for ``/metrics?exemplars=1`` (our own tools: the trace
+        resolver behind the waterfall buckets)."""
         lines: List[str] = []
         for m in self.metrics():
             if m.help:
                 lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.render())
+            lines.extend(m.render(exemplars=exemplars)
+                         if isinstance(m, Histogram) else m.render())
         return "\n".join(lines) + "\n"
 
     def unregister(self, name: str) -> None:

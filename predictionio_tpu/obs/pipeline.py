@@ -129,49 +129,48 @@ class PipelineProbe:
         self.model = model
         self._timeline = timeline if timeline is not None else get_timeline()
         self._labels = {"model": model}
-        labelnames = ("model",)
         self._host_wait = reg.histogram(
             "pio_train_host_wait_ms",
             "Time blocked fetching the next training batch (host side).",
-            labelnames)
+            ("model",))
         self._h2d = reg.histogram(
             "pio_train_h2d_ms",
             "Time staging a batch for the device (convert + transfer).",
-            labelnames)
+            ("model",))
         self._h2d_overlap = reg.histogram(
             "pio_train_h2d_overlap_ms",
             "Background staging time overlapped under device compute "
             "(prefetched pipeline; not part of the step-loop wall).",
-            labelnames)
+            ("model",))
         self._dispatch = reg.histogram(
             "pio_train_dispatch_ms",
             "Time inside the step call (cache lookup + enqueue; on "
             "synchronous-dispatch backends the execution itself).",
-            labelnames)
+            ("model",))
         self._device_wait = reg.histogram(
             "pio_train_device_wait_ms",
             "Host stall waiting on the previously dispatched device step.",
-            labelnames)
+            ("model",))
         self._device_step = reg.histogram(
             "pio_train_device_step_ms",
             "Device-step duration: dispatch to outputs ready.",
-            labelnames)
+            ("model",))
         self._last = {
             "host_wait": reg.gauge(
                 "pio_train_last_host_wait_ms",
-                "host_wait of the most recent iteration.", labelnames),
+                "host_wait of the most recent iteration.", ("model",)),
             "h2d": reg.gauge(
                 "pio_train_last_h2d_ms",
-                "h2d of the most recent iteration.", labelnames),
+                "h2d of the most recent iteration.", ("model",)),
             "device_wait": reg.gauge(
                 "pio_train_last_device_wait_ms",
-                "device_wait of the most recent iteration.", labelnames),
+                "device_wait of the most recent iteration.", ("model",)),
         }
         self._steps = reg.counter(
-            "pio_train_steps_total", "Optimizer steps run.", labelnames)
+            "pio_train_steps_total", "Optimizer steps run.", ("model",))
         self._examples = reg.counter(
             "pio_train_examples_total",
-            "Training examples consumed (pre-padding).", labelnames)
+            "Training examples consumed (pre-padding).", ("model",))
         self._pending: Optional[Any] = None
         self._pending_t0 = 0.0
         # Reference point for the dispatch interval: end of the last

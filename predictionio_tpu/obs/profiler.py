@@ -21,7 +21,7 @@ import os
 import tempfile
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from predictionio_tpu.obs.runtime import publish_event
 
@@ -89,6 +89,8 @@ class ProfilerSession:
         self._clock = clock
         self._timer_factory = timer_factory
         self._lock = threading.Lock()
+        # Serializes in-memory artifact tar builds (see artifact()).
+        self._artifact_lock = threading.Lock()
         self._active_path: Optional[str] = None
         self._started_at: Optional[float] = None
         self._duration_ms: float = 0.0
@@ -159,6 +161,50 @@ class ProfilerSession:
             return {"active": True, "path": self._active_path,
                     "durationMs": self._duration_ms,
                     "remainingMs": max(self._duration_ms - elapsed_ms, 0.0)}
+
+    def artifact(self) -> Optional[Tuple[bytes, str]]:
+        """(tar.gz bytes, filename) of the LAST finished capture — the
+        download behind ``GET /admin/profile/artifact`` (ISSUE 9
+        satellite: captures returned server-local paths since PR 3, so
+        remote/fleet operation needed box access to retrieve them).
+
+        Only the session's own ``_last_path`` is ever archived — the
+        endpoint can not be steered at arbitrary server paths.  Returns
+        None when no finished capture exists (HTTP 404 upstream); raises
+        :class:`ProfilerBusy` while one is running (the artifact is
+        still being written).
+
+        The archive is built in memory (the handler plumbing answers
+        with payload bytes either way); concurrent downloads serialize
+        on a build lock so N clients cost ONE archive's peak at a time,
+        not N."""
+        import io
+        import tarfile
+
+        with self._artifact_lock:
+            # Busy-check INSIDE the build lock: a waiter that queued
+            # behind another download must re-validate, or a capture
+            # armed meanwhile (same PIO_PROFILE_OUT dir) gets archived
+            # while being written.
+            with self._lock:
+                if self._active_path is not None:
+                    raise ProfilerBusy(
+                        f"capture still running to {self._active_path}")
+                path = self._last_path
+            if not path or not os.path.isdir(path):
+                return None
+            buf = io.BytesIO()
+            base = os.path.basename(os.path.normpath(path)) or "pio_profile"
+            try:
+                with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                    tar.add(path, arcname=base)
+            except OSError as e:
+                # Files vanished/changed mid-walk: a capture started into
+                # this directory after the busy-check — same verdict as
+                # catching it before (409), never a truncated archive.
+                raise ProfilerBusy(
+                    f"capture artifacts changed while archiving: {e}")
+            return buf.getvalue(), f"{base}.tar.gz"
 
 
 _profiler = ProfilerSession()

@@ -140,6 +140,12 @@ class Span:
 def _jsonable(v: Any) -> Any:
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
+    if isinstance(v, dict):
+        # Structured attrs (the waterfall's stages map) keep their shape
+        # in the recorded trace instead of collapsing to repr strings.
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
     return str(v)
 
 
@@ -257,11 +263,23 @@ class TraceRecorder:
                 "slow %s: %.1f ms (threshold %.0f ms) trace=%s attrs=%s",
                 root.name, dur, slow_ms, trace_id, root.attrs)
 
-    def recent(self, n: int = 50) -> List[Dict[str, Any]]:
-        """Last ``n`` finished traces, most recent first (/traces.json)."""
+    def recent(self, n: int = 50, *, request_id: Optional[str] = None,
+               min_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Last ``n`` finished traces, most recent first (/traces.json).
+
+        ``request_id`` filters to exact trace-id matches — the resolver
+        for exemplar links out of the ``pio_serve_stage_ms`` waterfall
+        buckets (ISSUE 9 satellite: an exemplar names ONE request; the
+        endpoint must answer with that one trace, not the whole ring).
+        ``min_ms`` keeps only traces at least that slow."""
         with self._lock:
             items = list(self._ring)
-        return items[::-1][:max(n, 0)]
+        out = items[::-1]
+        if request_id is not None:
+            out = [t for t in out if t.get("traceId") == request_id]
+        if min_ms is not None:
+            out = [t for t in out if (t.get("durationMs") or 0.0) >= min_ms]
+        return out[:max(n, 0)]
 
     def clear(self) -> None:
         with self._lock:

@@ -441,13 +441,22 @@ class ReplayWorker:
                  insert_fn: Callable[[Dict[str, Any]], Any],
                  interval_s: float = 0.25, batch: int = 100,
                  transient_types: Tuple[Type[BaseException], ...]
-                 = _DEFAULT_TRANSIENT):
+                 = _DEFAULT_TRANSIENT,
+                 wait: Optional[Callable[[threading.Event, float], bool]]
+                 = None):
         self.journal = journal
         self.insert_fn = insert_fn
         self.interval_s = float(interval_s)
         self.batch = int(batch)
         self.transient_types = transient_types
         self._stop = threading.Event()
+        # Injectable tick wait (ISSUE 9 deflake satellite): the default
+        # rides the stop event's wall-clock wait; tests inject a waiter
+        # that parks the thread (or advances a fake clock) so replay
+        # timing is driven deterministically — the same injectable-clock
+        # discipline as serving.queue.Clock / CircuitBreaker.
+        self._wait = wait if wait is not None else \
+            (lambda ev, timeout: ev.wait(timeout))
         self._thread = threading.Thread(
             target=self._run, name="pio-spill-replay", daemon=True)
 
@@ -455,7 +464,7 @@ class ReplayWorker:
         self._thread.start()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not self._wait(self._stop, self.interval_s):
             try:
                 self.drain_once()
             except Exception:

@@ -53,6 +53,7 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 import numpy as np
 
 from predictionio_tpu.obs import get_registry, span
+from predictionio_tpu.obs.waterfall import record_stage
 from predictionio_tpu.retrieval import exact as _exact
 from predictionio_tpu.retrieval.ivf import (
     IVFIndex,
@@ -353,6 +354,11 @@ class Retriever:
         self._m_requests.inc(rung=p.rung, corpus=self.name)
         self._m_candidates.inc(scanned, rung=p.rung, corpus=self.name)
         self._m_latency.observe(ms, rung=p.rung)
+        # Waterfall hand-off (ISSUE 9): the serving batcher routes this
+        # into the per-dispatch sink and fans it out to every member of
+        # the cohort as the rung-tagged "retrieval" stage (⊂ dispatch).
+        record_stage("retrieval", ms, rung=p.rung,
+                     retrievalCandidates=scanned)
         info = {"rung": p.rung, "k": p.k, "nprobe": p.nprobe,
                 "candidates": scanned, "ms": ms}
         return scores, ids, info

@@ -60,13 +60,30 @@ class AdminServer:
 
     def handle(self, method: str, path: str, body: bytes,
                params: Optional[Dict[str, List[str]]] = None
-               ) -> Tuple[int, dict | list]:
+               ) -> tuple:
+        """(status, payload) or (status, bytes, ctype) for the binary
+        artifact download."""
         params = params or {}
         try:
             if path == "/" and method == "GET":
                 return 200, {"status": "alive", "version": __version__}
             if path == "/admin/profile":
                 return self._handle_profile(method, params)
+            if path == "/admin/profile/artifact" and method == "GET":
+                # Download the finished capture as a tar.gz (ISSUE 9
+                # satellite): remote/fleet operators no longer need box
+                # access to pick up the server-local artifact dir.
+                try:
+                    art = get_profiler().artifact()
+                except ProfilerBusy as e:
+                    return 409, {"message": str(e)}
+                if art is None:
+                    return 404, {"message": "no finished profiler capture "
+                                            "in this process"}
+                data, filename = art
+                return 200, data, "application/gzip", {
+                    "Content-Disposition":
+                        f'attachment; filename="{filename}"'}
             if path == "/timeline.json" and method == "GET":
                 return 200, timeline_payload(params)
             if path == "/v1/cmd/app" and method == "GET":

@@ -6,6 +6,13 @@ evaluations with their metric scores.  JSON endpoints added for tooling:
 ``GET /engine_instances.json``, ``GET /evaluation_instances.json``, plus
 the shared observability views ``GET /metrics`` / ``GET /traces.json`` /
 ``GET /timeline.json``.
+
+ISSUE 9 (fleet telemetry): ``GET /fleet.json`` scrapes a configured list
+of instance base URLs (``PIO_FLEET_INSTANCES`` or the ``fleet``
+constructor arg), merges ``/metrics`` type-correctly (counters sum,
+histogram buckets add, gauges keep an ``instance`` label), and carries
+each instance's SLO/batcher state and timeline summary — the dashboard
+finally sees N processes, not one.
 """
 
 from __future__ import annotations
@@ -14,15 +21,21 @@ import html
 import json
 import logging
 import threading
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import get_recorder, get_registry
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.obs.fleet import (
+    FleetAggregator,
+    fleet_instances_from_env,
+)
 from predictionio_tpu.server.http import (
     BaseHandler,
     PROMETHEUS_CTYPE,
     ThreadingHTTPServer,
     timeline_payload,
+    traces_payload,
+    param_bool,
 )
 from predictionio_tpu.version import __version__
 
@@ -37,7 +50,7 @@ def _fmt_time(t) -> str:
 
 class DashboardServer:
     def __init__(self, storage: Optional[Storage] = None, host: str = "127.0.0.1",
-                 port: int = 9000):
+                 port: int = 9000, fleet: Optional[List[str]] = None):
         self.storage = storage or get_storage()
         self.host = host
         self.port = port
@@ -48,6 +61,9 @@ class DashboardServer:
         self._latency = self.registry.histogram(
             "pio_dashboard_request_latency_ms",
             "Dashboard request handling latency.")
+        instances = fleet if fleet is not None else fleet_instances_from_env()
+        self.fleet: Optional[FleetAggregator] = (
+            FleetAggregator(instances) if instances else None)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -96,13 +112,22 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
         if path == "/":
             return 200, "text/html; charset=UTF-8", self._index_html()
         if path == "/metrics":
-            return 200, PROMETHEUS_CTYPE, self.registry.render()
+            return 200, PROMETHEUS_CTYPE, self.registry.render(
+                exemplars=param_bool(params, "exemplars"))
         if path == "/traces.json":
             return 200, "application/json", json.dumps(
-                {"traces": get_recorder().recent(50)})
+                traces_payload(params or {}))
         if path == "/timeline.json":
             return 200, "application/json", json.dumps(
                 timeline_payload(params or {}))
+        if path == "/fleet.json":
+            if self.fleet is None:
+                return 200, "application/json", json.dumps({
+                    "instances": [],
+                    "message": "no fleet configured — set "
+                               "PIO_FLEET_INSTANCES or `pio dashboard "
+                               "--fleet URL,URL`"})
+            return 200, "application/json", json.dumps(self.fleet.scrape())
         if path == "/engine_instances.json":
             rows = [
                 {"id": r.id, "status": r.status,

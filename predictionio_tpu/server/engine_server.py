@@ -31,12 +31,13 @@ from predictionio_tpu.data.storage import (
     get_storage,
 )
 from predictionio_tpu.obs import (
-    get_recorder,
     get_registry,
     publish_event,
     span,
     start_runtime_introspection,
 )
+from predictionio_tpu.obs import waterfall as _waterfall
+from predictionio_tpu.obs.slo import SLOConfig, SLOEngine
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.resilience.faults import fault_point
@@ -49,6 +50,8 @@ from predictionio_tpu.server.http import (
     BaseHandler,
     ThreadingHTTPServer,
     timeline_payload,
+    traces_payload,
+    param_bool,
 )
 from predictionio_tpu.config import env_bool
 from predictionio_tpu.serving import (
@@ -272,6 +275,13 @@ class EngineServer:
         self.scheduler = ServingScheduler(
             config=scheduler_config or SchedulerConfig.from_env())
         self.scheduler.register("default", self._dispatch_batch)
+        # SLO engine (ISSUE 9): multi-window burn rates over the serving
+        # instruments + the autotuner's persistent-floor saturation
+        # detector, combined into the /ready degradation verdict
+        # (PIO_READY_SLO=off disables the flip, never the gauges).
+        self.slo = SLOEngine(SLOConfig.from_env(),
+                             registry=reg,
+                             saturation_fn=self.scheduler.saturated)
 
     def _load_candidate(self):
         """Storage-read phase of the staged reload (runs under the
@@ -563,27 +573,42 @@ class EngineServer:
                     "retainPreviousTtlS": self._retain_ttl_s or None,
                     "breaker": self._breaker.state,
                     "batcher": self.scheduler.snapshot(),
+                    "slo": self.slo.snapshot(),
                     "version": __version__,
                 }
             if path == "/ready" and method == "GET":
-                # Readiness (vs "/" liveness): a model is loaded and
-                # serving — 503 rotates the instance out of the LB pool.
+                # Readiness (vs "/" liveness): a model is loaded AND the
+                # SLO/saturation signal is healthy — 503 rotates the
+                # instance out of the LB pool (ISSUE 9: persistent-floor
+                # saturation + burn rate flip this; PIO_READY_SLO=off is
+                # the operator escape hatch; hysteresis in the engine).
                 with self._swap_lock:
                     inst = self._instance
                     serving = self._serving
-                ok = inst is not None and serving is not None
+                loaded = inst is not None and serving is not None
+                slo_ok, slo_state = self.slo.ready()
+                ok = loaded and slo_ok
+                status = "ready" if ok else (
+                    "degraded" if loaded else "unavailable")
                 return (200 if ok else 503), {
-                    "status": "ready" if ok else "unavailable",
+                    "status": status,
                     "engineInstanceId": inst.id if inst else None,
+                    "slo": slo_state,
                 }
             if path == "/metrics" and method == "GET":
                 # THE process-wide exposition (shared registry render).
-                return 200, self.stats.registry.render()
+                # ?exemplars=1 appends the OpenMetrics trace-id suffixes
+                # to waterfall buckets — opt-in, classic scrapers choke.
+                return 200, self.stats.registry.render(
+                    exemplars=param_bool(params, "exemplars"))
             if path == "/stats.json" and method == "GET":
                 return 200, {**self.stats.snapshot(),
-                             "batcher": self.scheduler.snapshot()}
+                             "batcher": self.scheduler.snapshot(),
+                             "slo": self.slo.snapshot()}
             if path == "/traces.json" and method == "GET":
-                return 200, {"traces": get_recorder().recent(50)}
+                # ?request_id= resolves waterfall exemplars to ONE trace;
+                # ?min_ms=/?limit= bound the view (shared helper).
+                return 200, traces_payload(params)
             if path == "/timeline.json" and method == "GET":
                 # Step-timeline ring: ?model=/?n=/?format=chrome for the
                 # chrome://tracing / Perfetto export.
@@ -610,6 +635,12 @@ class EngineServer:
                              "generation": self._generation}
             if path == "/queries.json" and method == "POST":
                 t0 = time.perf_counter()
+                # Arm the latency waterfall (ISSUE 9): stages stamped
+                # here (bind), by the batcher (queue/batch/dispatch/
+                # retrieval), and by the transport driver (serialize/
+                # shed_check), which also finalizes + publishes it after
+                # the response is written.
+                _waterfall.activate()
                 try:
                     # Shed BEFORE admission: a request whose budget is
                     # spent must not occupy a queue slot.
@@ -617,11 +648,32 @@ class EngineServer:
                     # Bind BEFORE admission: a malformed query 400s on
                     # this thread and never occupies a queue slot or
                     # fails the batch it would have ridden in.
+                    tb = time.perf_counter()
+                    # ingress: transport receipt → here (socket body
+                    # read, trace setup, routing, the deadline check) —
+                    # real wall the attestation contains, so the
+                    # waterfall must bill it.
+                    t0t = _waterfall.transport_start()
+                    if t0t is not None and tb > t0t:
+                        _waterfall.record_stage("ingress",
+                                                (tb - t0t) * 1e3)
                     q = self._bind_query(json.loads(body.decode("utf-8")))
+                    _waterfall.record_stage(
+                        "bind", (time.perf_counter() - tb) * 1e3)
                     # The ONLY route to the model: admission queue →
                     # micro-batcher → vectorized dispatch (ISSUE 6; the
                     # lint forbids calling query/query_batch from here).
-                    result = self.scheduler.submit_and_wait("default", q)
+                    wf = _waterfall.current_waterfall()
+                    try:
+                        result = self.scheduler.submit_and_wait(
+                            "default", q)
+                    finally:
+                        # shed_check opens here: the transport stamps it
+                        # from this mark so the span-unwind/stats segment
+                        # between scheduler hand-back and the respond
+                        # write is accounted, not lost.
+                        if wf is not None:
+                            wf.mark("handler_done")
                     # Final gate: a result that arrived past its own
                     # deadline is never served as a slow 200 — the
                     # client's budget is spent, so it gets the same 504

@@ -46,7 +46,6 @@ from predictionio_tpu.data.storage import (
     get_storage,
 )
 from predictionio_tpu.obs import (
-    get_recorder,
     get_registry,
     start_runtime_introspection,
 )
@@ -59,7 +58,12 @@ from predictionio_tpu.resilience.spill import (
     SpillJournal,
     resolve_spill_dir,
 )
-from predictionio_tpu.server.http import BaseHandler, ThreadingHTTPServer
+from predictionio_tpu.server.http import (
+    BaseHandler,
+    ThreadingHTTPServer,
+    traces_payload,
+    param_bool,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -118,7 +122,8 @@ class EventServer:
                  port: int = 7070, plugins=None, *,
                  breaker: Optional[CircuitBreaker] = None,
                  spill_dir: Optional[str] = None,
-                 replay_interval_s: Optional[float] = None):
+                 replay_interval_s: Optional[float] = None,
+                 replay_wait=None):
         from predictionio_tpu.server.plugins import PluginManager
 
         self.storage = storage or get_storage()
@@ -167,7 +172,10 @@ class EventServer:
                 interval_s=(replay_interval_s if replay_interval_s is not None
                             else float(os.environ.get(
                                 "PIO_SPILL_REPLAY_INTERVAL_S", "0.5"))),
-                transient_types=_UNAVAILABLE + (OSError,))
+                transient_types=_UNAVAILABLE + (OSError,),
+                # Injectable tick wait (tests drive replay with a fake
+                # clock / direct drain instead of wall-clock polling).
+                wait=replay_wait)
             self._replay.start()
         # Server plugin seam (reference: EventServerPlugin, SURVEY §5.1):
         # env-discovered request instrumentation, active on the python
@@ -319,7 +327,9 @@ class EventServer:
         if path == "/metrics" and method == "GET":
             # THE process-wide exposition: every subsystem's instruments
             # (ingest, serving, training, plugins) in one scrape.
-            return 200, self.stats.registry.render()
+            # ?exemplars=1 opts into the OpenMetrics exemplar suffixes.
+            return 200, self.stats.registry.render(
+                exemplars=param_bool(params, "exemplars"))
 
         key_row, err = self._auth(params, headers)
         if err:
@@ -328,7 +338,7 @@ class EventServer:
         if path == "/traces.json" and method == "GET":
             # Behind accessKey, unlike the aggregate /metrics//stats.json
             # views: traces carry PER-REQUEST paths/timings/request ids.
-            return 200, {"traces": get_recorder().recent(50)}
+            return 200, traces_payload(params)
         channel_id, cerr = self._resolve_channel(key_row.app_id, params)
         if cerr:
             return 400, {"message": cerr}

@@ -38,8 +38,11 @@ from http.server import (
 from typing import Any, Dict, List, Optional, Tuple, Union
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.obs import waterfall as _waterfall
 from predictionio_tpu.obs.trace import (
+    attach_event,
     current_trace_id,
+    get_recorder,
     sanitize_trace_id,
     slow_request_ms,
     span,
@@ -60,6 +63,7 @@ __all__ = [
     "incoming_deadline_ms",
     "payload_bytes",
     "timeline_payload",
+    "traces_payload",
 ]
 
 REQUEST_ID_HEADER = "X-Request-ID"
@@ -125,10 +129,47 @@ def timeline_payload(params: Dict[str, List[str]]) -> Dict[str, Any]:
     return {"steps": tl.recent(n, model=model), "models": summaries}
 
 
+def param_bool(params: Optional[Dict[str, List[str]]], key: str,
+               default: bool = False) -> bool:
+    """Boolean query param in the same dialect as env_bool — so
+    ``?exemplars=0`` / ``?exemplars=off`` actually means OFF (a bare
+    presence check would read an explicit opt-out as opt-in)."""
+    from predictionio_tpu.config import env_bool
+
+    vals = (params or {}).get(key) or [""]
+    return env_bool(vals[0], default)
+
+
+def traces_payload(params: Dict[str, List[str]]) -> Dict[str, Any]:
+    """The shared ``GET /traces.json`` view (every frontend).
+
+    ``?request_id=`` resolves one exact trace (exemplar links from the
+    ``pio_serve_stage_ms`` waterfall buckets land here), ``?min_ms=``
+    keeps only traces at least that slow, ``?limit=`` bounds the count
+    (default 50, clamped to the ring)."""
+    request_id = sanitize_trace_id(params.get("request_id", [None])[0])
+    try:
+        limit = int(params.get("limit", ["50"])[0])
+    except ValueError:
+        limit = 50
+    min_ms: Optional[float] = None
+    raw = params.get("min_ms", [None])[0]
+    if raw:
+        try:
+            min_ms = float(raw)
+        except ValueError:
+            min_ms = None
+    return {"traces": get_recorder().recent(
+        limit, request_id=request_id, min_ms=min_ms)}
+
+
 # A handler hook's result: (status, payload) with the content type
-# inferred by payload_bytes, or (status, payload, ctype) when the
-# frontend picks its own (the dashboard's HTML pages).
-HandlerResult = Union[Tuple[int, Any], Tuple[int, Any, str]]
+# inferred by payload_bytes, (status, payload, ctype) when the frontend
+# picks its own (the dashboard's HTML pages), or
+# (status, payload, ctype, headers) when it also sets response headers
+# (the profiler artifact's Content-Disposition).
+HandlerResult = Union[Tuple[int, Any], Tuple[int, Any, str],
+                      Tuple[int, Any, str, Dict[str, str]]]
 
 
 class BaseHandler(BaseHTTPRequestHandler):
@@ -187,6 +228,10 @@ class BaseHandler(BaseHTTPRequestHandler):
 
     def dispatch(self, method: str) -> None:
         t0 = time.perf_counter()
+        # Receipt wall for the waterfall's ingress stage (the engine
+        # handler arms the collector mid-handle, after body read+routing
+        # already happened — it reads this to bill them).
+        _waterfall.note_transport_start(t0)
         with trace("http.request",
                    trace_id=incoming_request_id(self.headers),
                    slow_ms=slow_request_ms(),
@@ -211,7 +256,11 @@ class BaseHandler(BaseHTTPRequestHandler):
                         out = self.pio_handle(method, parsed.path, params,
                                               body)
                     remaining = _deadline.remaining_ms()
-            if len(out) == 3:
+            t_shed = time.perf_counter()
+            handler_headers: Dict[str, str] = {}
+            if len(out) == 4:
+                status, payload, ctype, handler_headers = out  # type: ignore[misc]
+            elif len(out) == 3:
                 status, payload, ctype = out  # type: ignore[misc]
             else:
                 status, payload = out  # type: ignore[misc]
@@ -227,6 +276,8 @@ class BaseHandler(BaseHTTPRequestHandler):
             ms = (time.perf_counter() - t0) * 1e3
             extra = dict(self.pio_on_complete(method, parsed.path, status,
                                               ms, body, params) or {})
+            for k, v in handler_headers.items():
+                extra.setdefault(k, v)
             # The server's own read+handle wall time: clients (and the
             # serving bench) use it to attribute client-vs-server latency
             # drift and to ATTEST deadline compliance — a 200 whose
@@ -244,7 +295,18 @@ class BaseHandler(BaseHTTPRequestHandler):
             retry_after = self.pio_retry_after_s()
             if retry_after is not None and status in self.retry_after_statuses:
                 extra.setdefault("Retry-After", str(retry_after))
-            with span("http.respond"):
+            wf = _waterfall.current_waterfall()
+            if wf is not None:
+                # shed_check: scheduler hand-back → the respond write —
+                # the handler's span unwind + stats hooks (from the
+                # handler_done mark when the engine set one), the
+                # late-shed verdict, and response-header assembly.  Small,
+                # but the waterfall must account for it so the stage sum
+                # reconciles with X-PIO-Server-Ms.
+                t_fin = wf.take_mark("handler_done") or t_shed
+                wf.stamp("shed_check",
+                         (time.perf_counter() - t_fin) * 1e3)
+            with span("http.respond") as rspan:
                 if ctype is None:
                     data, ctype = payload_bytes(payload)
                 else:
@@ -252,6 +314,18 @@ class BaseHandler(BaseHTTPRequestHandler):
                             else payload)
                 self.respond(status, data, ctype, extra,
                              request_id=current_trace_id())
+            if wf is not None:
+                # serialize: result → JSON bytes + the socket write.
+                wf.stamp("serialize", rspan.duration_ms or 0.0)
+                doc = wf.finalize(
+                    trace_id=current_trace_id(), status=status,
+                    total_ms=(time.perf_counter() - t0) * 1e3,
+                    attested_ms=ms)
+                if doc:
+                    attach_event(troot, "waterfall",
+                                 **{k: v for k, v in doc.items()
+                                    if k not in ("ts", "traceId")})
+                _waterfall.deactivate()
 
     def respond(self, status: int, data: bytes, ctype: str,
                 extra_headers: Optional[Dict[str, str]] = None,

@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from predictionio_tpu.config import env_bool as _truthy
 from predictionio_tpu.obs import get_registry
 from predictionio_tpu.obs.trace import current_span
+from predictionio_tpu.obs.waterfall import current_waterfall
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.serving.autotune import WindowAutotuner
@@ -213,7 +214,11 @@ class ServingScheduler:
         now = self.clock.now()
         rem = _deadline.remaining_ms()
         deadline_s = now + rem / 1e3 if rem is not None else None
-        pending = Pending(query, now, deadline_s, span=current_span())
+        # The request's stage collector rides the Pending hand-off so the
+        # batcher thread can stamp queue_wait/batch_wait/dispatch/
+        # retrieval onto it (ISSUE 9 waterfall).
+        pending = Pending(query, now, deadline_s, span=current_span(),
+                          waterfall=current_waterfall())
         if not self.config.enabled:
             return self._submit_inline(model, lane, pending)
         try:
@@ -235,6 +240,19 @@ class ServingScheduler:
                     f"({timeout * 1e3:.0f}ms budget)")
             raise SchedulerStalled(
                 f"no dispatch within {stall_s:.0f}s — batcher wedged?")
+        if pending.waterfall is not None:
+            # resume: dispatch done → this thread actually running again
+            # (event wake-up + GIL/thread contention).  Computed as the
+            # admission→result wall minus the batcher-attributed stages,
+            # ON THE SAME CLOCK the batcher stamped with — without it the
+            # waterfall's stage sum undershoots the server-attested wall
+            # under concurrency and misattributes scheduling overhead.
+            done = pending.waterfall.snapshot()
+            resid = (self.clock.now() - now) * 1e3 - sum(
+                done.get(s, 0.0)
+                for s in ("queue_wait", "batch_wait", "dispatch"))
+            if resid > 0:
+                pending.waterfall.stamp("resume", resid)
         if pending.error is not None:
             raise pending.error
         return pending.result
@@ -261,6 +279,13 @@ class ServingScheduler:
         return pending.result
 
     # -- introspection / lifecycle ------------------------------------------
+
+    def saturated(self) -> bool:
+        """Any model lane's autotuner reporting persistent-floor
+        saturation (offered load > capacity) — the serving half of the
+        SLO engine's /ready degradation signal."""
+        return any(lane.autotuner is not None and lane.autotuner.saturated()
+                   for lane in self._lanes.values())
 
     def snapshot(self) -> Dict[str, Any]:
         """Status-page view (``GET /`` / ``/stats.json`` /
@@ -290,6 +315,8 @@ class ServingScheduler:
                                 if lane.autotuner
                                 and lane.autotuner.last_p99_ms is not None
                                 else None),
+                "saturated": (lane.autotuner.saturated()
+                              if lane.autotuner else False),
             }
         return out
 

@@ -65,6 +65,7 @@ class WindowAutotuner:
         interval: int = 32,
         sample_size: int = 512,
         grow_fraction: float = 0.6,
+        saturation_streak: int = 3,
         registry=None,
     ):
         self.model = model
@@ -79,6 +80,14 @@ class WindowAutotuner:
         self._samples: Deque[float] = deque(maxlen=sample_size)
         self._since_retune = 0
         self.last_p99_ms: Optional[float] = None
+        # Persistent-floor saturation detector (ISSUE 9 / ROADMAP rung):
+        # the "floor" action means p99 is over target with the window
+        # already at its minimum AND the dispatch itself fast — i.e. the
+        # backlog, not the batching, is the latency.  A streak of them is
+        # the controller saying "offered load > capacity"; the SLO engine
+        # combines this with burn rate to flip /ready.
+        self.saturation_streak = max(int(saturation_streak), 1)
+        self._floor_streak = 0
         reg = registry or get_registry()
         self._m_actions = reg.counter(
             "pio_batch_autotune_total",
@@ -87,6 +96,11 @@ class WindowAutotuner:
             "pio_batch_served_p99_ms",
             "Autotuner's sliding-window served-latency p99 estimate.",
             ("model",))
+        self._m_saturated = reg.gauge(
+            "pio_batch_saturated",
+            "1 while the autotuner's persistent-floor detector reports "
+            "offered load > capacity for this model lane.", ("model",))
+        self._m_saturated.set(0, model=model)
 
     def observe(self, served_latency_ms: float) -> None:
         with self._lock:
@@ -103,6 +117,19 @@ class WindowAutotuner:
             return
         self.retune(batcher, _quantile(samples, 0.99))
 
+    def saturated(self) -> bool:
+        """Persistent-floor verdict: ≥ ``saturation_streak`` consecutive
+        retunes ended in the ``floor`` action (nothing left to shrink,
+        p99 still over target).  Any other action clears the streak —
+        capacity returned or a knob still had room."""
+        return self._floor_streak >= self.saturation_streak
+
+    def _track_floor(self, action: str) -> None:
+        self._floor_streak = (self._floor_streak + 1
+                              if action == "floor" else 0)
+        self._m_saturated.set(1 if self.saturated() else 0,
+                              model=self.model)
+
     def retune(self, batcher, p99_ms: float) -> None:
         """One control step against an explicit p99 reading (tests call
         this directly; production arrives via :meth:`after_dispatch`)."""
@@ -118,7 +145,7 @@ class WindowAutotuner:
                 if new_w < max(self.window_min_s, 1e-4):
                     new_w = self.window_min_s
                 batcher.set_knobs(window_s=new_w)
-                self._m_actions.inc(model=self.model, action="shrink_window")
+                action = "shrink_window"
             elif (batcher.max_size > 1
                     and batcher._est_dispatch_s * 1e3
                     > 0.25 * self.target_p99_ms):
@@ -128,20 +155,22 @@ class WindowAutotuner:
                 # shrinking the batch there cuts throughput and makes
                 # the backlog, and the p99, strictly worse.
                 batcher.set_knobs(max_size=max(batcher.max_size // 2, 1))
-                self._m_actions.inc(model=self.model, action="shrink_batch")
+                action = "shrink_batch"
             else:
-                self._m_actions.inc(model=self.model, action="floor")
+                action = "floor"
         elif p99_ms < self.grow_fraction * self.target_p99_ms:
             if batcher.max_size < self.max_size_cap:
                 batcher.set_knobs(max_size=min(
                     batcher.max_size * 2, self.max_size_cap))
-                self._m_actions.inc(model=self.model, action="grow_batch")
+                action = "grow_batch"
             elif batcher.window_s < self.window_max_s:
                 batcher.set_knobs(window_s=min(
                     batcher.window_s + self.window_step_s,
                     self.window_max_s))
-                self._m_actions.inc(model=self.model, action="grow_window")
+                action = "grow_window"
             else:
-                self._m_actions.inc(model=self.model, action="ceiling")
+                action = "ceiling"
         else:
-            self._m_actions.inc(model=self.model, action="hold")
+            action = "hold"
+        self._m_actions.inc(model=self.model, action=action)
+        self._track_floor(action)

@@ -41,6 +41,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from predictionio_tpu.obs import get_registry
 from predictionio_tpu.obs.trace import attach_event, trace as _trace
+from predictionio_tpu.obs.waterfall import Waterfall, dispatch_sink
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.serving.queue import (
     Clock,
@@ -169,6 +170,9 @@ class MicroBatcher:
                 return []
         batch = [first]
         opened = self.clock.now()
+        # Waterfall: gather pickup splits the member's admission→dispatch
+        # wait into queue_wait (before pickup) and batch_wait (window).
+        first.gathered_s = opened
         window_s = self.window_s if self._lone_streak < 2 else 0.0
         close = opened + window_s
         close = min(close, self._latest_dispatch_s(first))
@@ -179,6 +183,7 @@ class MicroBatcher:
             entry = self.queue.take(self.clock, timeout=0)
             if entry is None:
                 break
+            entry.gathered_s = self.clock.now()
             batch.append(entry)
             close = min(close, self._latest_dispatch_s(entry))
         while len(batch) < self.max_size:
@@ -190,12 +195,26 @@ class MicroBatcher:
                 if self.queue.closed() or self.clock.now() >= close:
                     break
                 continue
+            entry.gathered_s = self.clock.now()
             batch.append(entry)
             close = min(close, self._latest_dispatch_s(entry))
         self._lone_streak = self._lone_streak + 1 if len(batch) == 1 else 0
         return batch
 
     # -- dispatch -----------------------------------------------------------
+
+    def _stamp_waits(self, e: Pending, end_s: float) -> None:
+        """queue_wait (admission → gather pickup) + batch_wait (pickup →
+        ``end_s``) onto a member's waterfall.  Called on EVERY finish
+        path — dispatch, pre-dispatch shed, failed batch — so a 504's
+        wall is attributed to queueing, never mistaken for the waiter's
+        post-dispatch resume residual."""
+        if e.waterfall is None:
+            return
+        gathered = e.gathered_s if e.gathered_s is not None else end_s
+        e.waterfall.stamp("queue_wait",
+                          max(gathered - e.enqueued_s, 0.0) * 1e3)
+        e.waterfall.stamp("batch_wait", max(end_s - gathered, 0.0) * 1e3)
 
     def dispatch(self, batch: Sequence[Pending]) -> int:
         """Claim, shed expired, run ONE vectorized dispatch, finish all.
@@ -210,6 +229,11 @@ class MicroBatcher:
                 continue  # waiter already walked (deadline) — silent drop
             if e.deadline_s is not None and now >= e.deadline_s:
                 # Expired in the queue: 504 upstream, no device work.
+                # Stamp the waits first so the 504's wide event bills
+                # this wall to queue_wait/batch_wait — NOT to the
+                # waiter's resume residual, which would misread pure
+                # overload as thread contention.
+                self._stamp_waits(e, now)
                 self._m_shed.inc(model=self.model, reason="expired")
                 e.finish(error=DeadlineExceeded(
                     "deadline expired while queued for batch dispatch "
@@ -219,7 +243,17 @@ class MicroBatcher:
         if not live:
             return 0
         batch_id = uuid.uuid4().hex[:12]
+        # Per-dispatch stage sink: library code under the dispatch (the
+        # retrieval facade) records stages here; the result is fanned out
+        # to every member's waterfall below — one corpus scan, one shared
+        # "retrieval" reading per cohort.
+        sink = Waterfall()
         t0 = self.clock.now()
+        # queue_wait/batch_wait are fully determined at dispatch start —
+        # stamp them NOW, on every outcome path (success, failure, retry),
+        # so no finish path leaks its wait into the resume residual.
+        for e in live:
+            self._stamp_waits(e, t0)
         try:
             # The dispatch is its own root trace (the batcher thread has
             # no request context): the ring shows every coalesced device
@@ -227,14 +261,24 @@ class MicroBatcher:
             # zero-duration event attached to their spans below.
             with _trace("batcher.dispatch", model=self.model,
                         batch_id=batch_id, batch_size=len(live)) as troot:
-                results, generation = self.dispatch_fn(
-                    [e.query for e in live])
+                with dispatch_sink(sink):
+                    results, generation = self.dispatch_fn(
+                        [e.query for e in live])
                 if len(results) != len(live):
                     raise ValueError(
                         f"dispatch returned {len(results)} results for "
                         f"{len(live)} queries")
                 troot.set(generation=generation)
         except Exception as exc:
+            # The failed attempt's device time is real wall the members
+            # waited through — bill it (stamps accumulate by design: a
+            # retried dispatch bills both attempts).
+            dt_fail = (self.clock.now() - t0) * 1e3
+            for e in live:
+                if e.waterfall is not None:
+                    e.waterfall.stamp("dispatch", dt_fail,
+                                      batchSize=len(live), failed=True,
+                                      model=self.model)
             if len(live) == 1:
                 # Retrying a singleton would replay the IDENTICAL call —
                 # pure double work for the same error.
@@ -252,10 +296,17 @@ class MicroBatcher:
         self._m_requests.inc(n, model=self.model)
         self._m_batch_size.observe(n, model=self.model)
         self._m_dispatch_ms.observe(dt * 1e3, model=self.model)
+        sink_stages, sink_attrs = sink.export()
         for e, r in zip(live, results):
             wait_ms = (t0 - e.enqueued_s) * 1e3
             self._m_wait_ms.observe(wait_ms, model=self.model)
             self._m_coalesce.observe(1.0 / n, model=self.model)
+            if e.waterfall is not None:
+                # queue_wait/batch_wait already stamped at dispatch start.
+                e.waterfall.stamp("dispatch", dt * 1e3,
+                                  batchSize=n, generation=generation,
+                                  model=self.model)
+                e.waterfall.merge(sink_stages, **sink_attrs)
             # Join the dispatch to the member request's own span tree:
             # its trace now shows which batch carried it, how big the
             # cohort was, and which model generation answered.  Routed
@@ -290,8 +341,21 @@ class MicroBatcher:
                     "deadline expired during batch retry "
                     f"({(now - e.deadline_s) * 1e3:.0f}ms over budget)"))
                 continue
+            t1 = self.clock.now()
             try:
-                results, generation = self.dispatch_fn([e.query])
+                sink = Waterfall()
+                with dispatch_sink(sink):
+                    results, generation = self.dispatch_fn([e.query])
+                if e.waterfall is not None:
+                    # Waits already stamped at the failed batch's start;
+                    # this retry's dispatch accumulates onto the failed
+                    # attempt's — a retried dispatch bills both.
+                    e.waterfall.stamp(
+                        "dispatch", (self.clock.now() - t1) * 1e3,
+                        batchSize=1, isolated=True,
+                        generation=generation, model=self.model)
+                    stages, attrs = sink.export()
+                    e.waterfall.merge(stages, **attrs)
                 e.annotate(attach_event, "batcher.dispatch",
                            batch_id=batch_id, model=self.model,
                            batch_size=1, isolated=True,
@@ -302,6 +366,11 @@ class MicroBatcher:
                 self._m_coalesce.observe(1.0, model=self.model)
                 e.finish(result=results[0])
             except Exception as exc:  # noqa: BLE001 - per-item verdict
+                if e.waterfall is not None:
+                    e.waterfall.stamp(
+                        "dispatch", (self.clock.now() - t1) * 1e3,
+                        batchSize=1, isolated=True, failed=True,
+                        model=self.model)
                 e.finish(error=exc)
 
     # -- loop / lifecycle ---------------------------------------------------

@@ -97,7 +97,8 @@ class Pending:
     """
 
     __slots__ = ("query", "enqueued_s", "deadline_s", "span", "state",
-                 "result", "error", "walked", "_lock", "_done")
+                 "result", "error", "walked", "_lock", "_done",
+                 "gathered_s", "waterfall")
 
     QUEUED = "queued"
     CLAIMED = "claimed"
@@ -105,7 +106,8 @@ class Pending:
     DONE = "done"
 
     def __init__(self, query: Any, enqueued_s: float,
-                 deadline_s: Optional[float] = None, span: Any = None):
+                 deadline_s: Optional[float] = None, span: Any = None,
+                 waterfall: Any = None):
         self.query = query
         self.enqueued_s = enqueued_s
         self.deadline_s = deadline_s
@@ -116,6 +118,14 @@ class Pending:
         # True once the submitting thread stopped waiting (deadline) —
         # its span tree may be serializing, so no one may touch it.
         self.walked = False
+        # Stamped by the batcher when a gather picks the entry up —
+        # splits the admission→dispatch wait into queue_wait (admission →
+        # pickup) and batch_wait (pickup → dispatch start).
+        self.gathered_s: Optional[float] = None
+        # The submitting request's stage collector (obs.waterfall); the
+        # collector is internally locked and close-once, so cross-thread
+        # stamps from the batcher are safe even against a walked waiter.
+        self.waterfall = waterfall
         self._lock = threading.Lock()
         self._done = threading.Event()
 

@@ -148,3 +148,86 @@ def test_timeline_endpoint_on_admin(admin):
     assert body["models"]["toy"]["steps"] == 1
     s, chrome = _req("GET", f"{base}/timeline.json?format=chrome")
     assert s == 200 and any(e["ph"] == "X" for e in chrome["traceEvents"])
+
+
+# -- profiler artifact download (ISSUE 9 satellite) -------------------------
+
+def _get_raw(url):
+    req = urllib.request.Request(url, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.mark.profiling
+def test_profile_artifact_download_roundtrip(admin, fake_profiler,
+                                             tmp_path):
+    """The capture path is SERVER-local; GET /admin/profile/artifact
+    ships it as a tar.gz so fleet operators never need box access."""
+    import io
+    import tarfile
+
+    from predictionio_tpu.obs.profiler import get_profiler
+
+    base = f"http://127.0.0.1:{admin.port}"
+    # nothing captured yet: a clear 404, not a crash
+    s, _, body = _get_raw(f"{base}/admin/profile/artifact")
+    assert s == 404 and b"no finished" in body
+    out = tmp_path / "prof"
+    out.mkdir()
+    (out / "trace.json.gz").write_bytes(b"fake-xplane-bytes")
+    s, _ = _req("POST", f"{base}/admin/profile?duration_ms=1000&out={out}")
+    assert s == 200
+    # while the capture is running the archive is still being written
+    s, _, _ = _get_raw(f"{base}/admin/profile/artifact")
+    assert s == 409
+    assert get_profiler().stop() == str(out)
+    s, headers, data = _get_raw(f"{base}/admin/profile/artifact")
+    assert s == 200
+    assert headers["Content-Type"] == "application/gzip"
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        names = tar.getnames()
+        member = tar.extractfile("prof/trace.json.gz")
+        assert member.read() == b"fake-xplane-bytes"
+    assert "prof" in names
+
+
+@pytest.mark.profiling
+def test_pio_profile_out_downloads_the_archive(admin, fake_profiler,
+                                               tmp_path, monkeypatch):
+    """`pio profile --url ... --out FILE` waits the window out and pulls
+    the archive down over HTTP (no server-box access)."""
+    import argparse
+    import tarfile
+
+    from predictionio_tpu.cli.main import cmd_profile
+    from predictionio_tpu.obs.profiler import get_profiler
+
+    capture_dir = tmp_path / "cap"
+    capture_dir.mkdir()
+    (capture_dir / "xplane.pb").write_bytes(b"pb")
+    monkeypatch.setenv("PIO_PROFILE_OUT", str(capture_dir))
+
+    # finish the session the moment the CLI polls it: the no-op fake
+    # timer never fires, so stop() here plays the role of the window
+    # closing on the server.
+    import threading
+
+    def _stop_soon():
+        get_profiler().stop()
+
+    t = threading.Timer(0.1, _stop_soon)
+    t.start()
+    dest = tmp_path / "got.tar.gz"
+    args = argparse.Namespace(duration_ms=10,
+                              url=f"http://127.0.0.1:{admin.port}",
+                              out=str(dest))
+    try:
+        assert cmd_profile(args) == 0
+    finally:
+        t.cancel()
+    assert dest.exists()
+    with tarfile.open(dest, mode="r:gz") as tar:
+        assert any(n.endswith("xplane.pb") for n in tar.getnames())

@@ -1,0 +1,109 @@
+"""tools/lint_metrics.py: the metrics schema stays fleet-merge-stable.
+
+ISSUE 9 satellite — the fleet aggregator merges /metrics expositions by
+TYPE (counters sum, histogram buckets add per-le, gauges keep an
+instance label).  That merge is only correct while every metric is
+pio_-prefixed, literally named, registered with ONE (kind, label-set)
+schema, and histograms declare schema-stable buckets.  This test runs
+the lint over the real tree and pins each rule against synthetic
+violations.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_metrics  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_metrics.check(REPO) == []
+
+
+def test_detects_computed_metric_name():
+    src = """
+from predictionio_tpu.obs import get_registry
+name = "pio_" + kind
+get_registry().counter(name, "computed name")
+"""
+    violations = lint_metrics.check_source(src, "bad.py")
+    assert len(violations) == 1
+    assert "not a string literal" in violations[0]
+
+
+def test_detects_missing_pio_prefix():
+    src = """
+from predictionio_tpu.obs import get_registry
+get_registry().gauge("requests_total", "bare name")
+"""
+    violations = lint_metrics.check_source(src, "bad.py")
+    assert len(violations) == 1
+    assert "pio_ prefix" in violations[0]
+
+
+def test_detects_non_literal_labelnames():
+    src = """
+from predictionio_tpu.obs import get_registry
+labels = ("model",)
+get_registry().counter("pio_x_total", "h", labels)
+"""
+    violations = lint_metrics.check_source(src, "bad.py")
+    assert len(violations) == 1
+    assert "labelnames" in violations[0]
+
+
+def test_detects_kind_and_label_schema_collisions():
+    src = """
+from predictionio_tpu.obs import get_registry
+get_registry().counter("pio_x_total", "h", ("model",))
+get_registry().gauge("pio_x_total", "h")
+get_registry().counter("pio_x_total", "h", ("model", "rung"))
+"""
+    violations = lint_metrics.check_source(src, "bad.py")
+    assert any("already a counter" in v for v in violations)
+    assert any("one (name, label-set) schema" in v for v in violations)
+
+
+def test_cross_module_collision_caught_via_shared_registry():
+    registry = {}
+    a = lint_metrics.check_source(
+        'r.histogram("pio_y_ms", "h", ("stage",))', "a.py", registry)
+    b = lint_metrics.check_source(
+        'r.histogram("pio_y_ms", "h", ("model",))', "b.py", registry)
+    assert a == []
+    assert len(b) == 1 and "a.py" in b[0]
+
+
+def test_histogram_bucket_rules():
+    # literal tuple: fine; UPPERCASE module constant: fine;
+    # runtime-computed: violation; differing literals: violation.
+    registry = {}
+    assert lint_metrics.check_source(
+        'r.histogram("pio_b_ms", "h", (), buckets=(1.0, 5.0))',
+        "a.py", registry) == []
+    assert lint_metrics.check_source(
+        'r.histogram("pio_c_ms", "h", (), buckets=LATENCY_BUCKETS)',
+        "a.py", registry) == []
+    v = lint_metrics.check_source(
+        'r.histogram("pio_d_ms", "h", (), buckets=make_buckets())',
+        "a.py", registry)
+    assert len(v) == 1 and "computed at runtime" in v[0]
+    v = lint_metrics.check_source(
+        'r.histogram("pio_b_ms", "h", (), buckets=(1.0, 9.0))',
+        "b.py", registry)
+    assert len(v) == 1 and "differ" in v[0]
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    pkg = tmp_path / "predictionio_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'r.counter("no_prefix_total", "h")\n', encoding="utf-8")
+    assert lint_metrics.main([str(tmp_path)]) == 1
+    out = capsys.readouterr()
+    assert "pio_ prefix" in out.out
+    (pkg / "mod.py").write_text(
+        'r.counter("pio_ok_total", "h")\n', encoding="utf-8")
+    assert lint_metrics.main([str(tmp_path)]) == 0

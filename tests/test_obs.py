@@ -41,6 +41,10 @@ _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _SAMPLE_RE = re.compile(
     rf"^({_NAME})(?:\{{(.*)\}})? (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|Inf)|\+Inf|NaN)$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)')
+_VALUE = r"(?:-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|Inf)|\+Inf|NaN)"
+# OpenMetrics exemplar suffix: ` # {labels} value` (ISSUE 9: histogram
+# buckets carry the trace id of the last observation that landed there).
+_EXEMPLAR_RE = re.compile(rf" # \{{((?:[^\"}}]|\"(?:[^\"\\]|\\.)*\")*)\}} ({_VALUE})$")
 
 
 def _parse_value(s: str) -> float:
@@ -76,9 +80,23 @@ def parse_prometheus(text: str):
             types[name] = kind
             continue
         assert not line.startswith("#"), f"unknown comment: {line!r}"
+        em = _EXEMPLAR_RE.search(line)
+        if em:
+            line = line[:em.start()]
         m = _SAMPLE_RE.match(line)
         assert m, f"malformed sample line: {line!r}"
         name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        if em:
+            # Exemplars are legal only on histogram bucket samples, and
+            # their labelset must itself be well-formed.
+            assert name.endswith("_bucket"), \
+                f"exemplar on non-bucket sample: {line!r}"
+            ex_labels = em.group(1)
+            consumed = sum(len(mm.group(0)) for mm in
+                           _LABEL_RE.finditer(ex_labels))
+            assert consumed == len(ex_labels), \
+                f"malformed exemplar labels: {ex_labels!r}"
+            _parse_value(em.group(2))
         base = re.sub(r"_(bucket|sum|count)$", "", name)
         assert base in types or name in types, \
             f"sample {name!r} has no # TYPE"

@@ -353,13 +353,26 @@ def test_mid_batch_outage_answers_every_item(pio_home):
 def test_full_outage_spills_200_events_then_replays_exactly_once(pio_home):
     """(b) + acceptance: a 200-event ingest during a total storage outage
     loses nothing — every event is journaled with 202, and after the
-    fault clears the replay thread lands exactly 200 events (no dupes),
-    with pio_spill_queue_depth draining to 0."""
+    fault clears the replay worker lands exactly 200 events (no dupes),
+    with pio_spill_queue_depth draining to 0.
+
+    Deflaked (ISSUE 9 satellite): the breaker AND the replay worker's
+    tick wait both ride injectable clocks now, so the drain is driven
+    deterministically from the test thread (``drain_once``) with ZERO
+    wall-clock sleeps/polls — the old version raced real replay-interval
+    ticks against a real breaker-recovery timer and occasionally lost
+    under full-suite load."""
+    clock = SimpleNamespace(t=0.0)
     breaker = CircuitBreaker(
         "eventdata", failure_threshold=2, recovery_time_s=0.04,
-        failure_types=(StorageUnavailable, ConnectionError))
+        failure_types=(StorageUnavailable, ConnectionError),
+        clock=lambda: clock.t)
+    # Park the replay THREAD until stop: the injected wait ignores the
+    # interval and blocks on the stop event, so the worker never races
+    # the test's own deterministic drain_once() calls.
     srv, key, storage, app_id = _event_stack(
-        pio_home, breaker=breaker, replay_interval_s=0.02)
+        pio_home, breaker=breaker, replay_interval_s=3600,
+        replay_wait=lambda ev, timeout: ev.wait())
     try:
         faults.install("storage.create:error:1.0")
         statuses = []
@@ -374,9 +387,14 @@ def test_full_outage_spills_200_events_then_replays_exactly_once(pio_home):
         assert breaker.state == "open"  # outage tripped it
 
         faults.clear()
-        deadline = time.monotonic() + 30
-        while srv.spill.depth() and time.monotonic() < deadline:
-            time.sleep(0.02)
+        # Breaker still open on the fake clock: a drain tick pauses on
+        # CircuitOpenError (transient) and loses nothing.
+        assert srv._replay.drain_once() == 0
+        assert srv.spill.depth() == 200
+        # Advance past recovery: half-open lets the drain probe through,
+        # the probe lands, the breaker closes, the queue drains fully.
+        clock.t += 0.05
+        assert srv._replay.drain_once() == 200
         assert srv.spill.depth() == 0
         assert get_registry().get("pio_spill_queue_depth").value() == 0
         assert get_registry().get("pio_spill_replayed_total").value() == 200
@@ -384,7 +402,7 @@ def test_full_outage_spills_200_events_then_replays_exactly_once(pio_home):
         events = list(storage.get_events().find(app_id))
         assert len(events) == 200  # exactly once: no loss, no duplicates
         assert {e.entity_id for e in events} == {f"u{i}" for i in range(200)}
-        assert breaker.state == "closed"  # replay worker probed it closed
+        assert breaker.state == "closed"  # replay drain probed it closed
     finally:
         srv.stop()
 

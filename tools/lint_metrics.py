@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Static check: the metrics schema stays fleet-merge-stable.
+
+ISSUE 9's fleet aggregation merges ``/metrics`` expositions from N
+instances by TYPE: counters sum, histogram buckets add per-``le``,
+gauges keep an ``instance`` label.  That merge is only correct while
+every instance registers every metric the same way — same kind, same
+label set, same bucket bounds — and while names stay statically known.
+This lint locks those invariants in (tier-1 test runs it in CI):
+
+1. Every ``<registry>.counter(...)`` / ``.gauge(...)`` /
+   ``.histogram(...)`` call in ``predictionio_tpu/`` passes its metric
+   name as a STRING LITERAL with the ``pio_`` prefix (a computed name
+   can't be schema-checked and breaks the naming convention README
+   documents).
+2. A name is registered with exactly ONE kind and ONE label set across
+   the whole package — the registry's get-or-create would raise at
+   runtime on a mismatch, but only on the code path that collides; this
+   catches it before it ships.  Label sets must be literal tuples/lists
+   of string literals for the same reason as rule 1.
+3. Histograms declare schema-stable buckets: either no ``buckets=``
+   argument (the module-constant default), or a literal tuple/list of
+   numbers, or a reference to a MODULE-LEVEL UPPERCASE constant.  A
+   bucket list computed at runtime could differ between instances and
+   silently corrupt the fleet's per-``le`` bucket addition.
+
+Usage: ``python tools/lint_metrics.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+_REGISTER_FNS = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}
+
+
+def _literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _literal_labelnames(node: Optional[ast.AST]) -> Optional[Tuple[str, ...]]:
+    """Labelnames as a tuple of literal strings; None when not literal."""
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = _literal_str(el)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def _bucket_spec(node: Optional[ast.AST]) -> Optional[str]:
+    """A stable string key for a bucket declaration, or None when the
+    declaration is not schema-stable (rule 3)."""
+    if node is None:
+        return "<default>"
+    if isinstance(node, ast.Name):
+        # Module-level constant by convention: UPPERCASE name.
+        return node.id if node.id.isupper() else None
+    if isinstance(node, ast.Attribute):
+        return node.attr if node.attr.isupper() else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(
+                    el.value, (int, float)):
+                vals.append(repr(float(el.value)))
+            else:
+                return None
+        return "(" + ",".join(vals) + ")"
+    return None
+
+
+def _call_parts(call: ast.Call):
+    """(name_node, labelnames_node, buckets_node) for a register call.
+
+    Signature shape: ``fn(name, help="", labelnames=(), [buckets=...])``
+    — positional help at index 1, labelnames at index 2."""
+    name = call.args[0] if call.args else None
+    labelnames = call.args[2] if len(call.args) > 2 else None
+    buckets = None
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            labelnames = kw.value
+        elif kw.arg == "buckets":
+            buckets = kw.value
+        elif kw.arg == "name":
+            name = kw.value
+    return name, labelnames, buckets
+
+
+def check_source(source: str, filename: str,
+                 registry: Optional[Dict[str, Dict]] = None) -> List[str]:
+    """Violations in one module; ``registry`` accumulates cross-module
+    (name → kind/labels/buckets) state for rule 2."""
+    registry = registry if registry is not None else {}
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTER_FNS):
+            continue
+        kind = _REGISTER_FNS[node.func.attr]
+        where = f"{filename}:{node.lineno}"
+        name_node, labels_node, buckets_node = _call_parts(node)
+        name = _literal_str(name_node)
+        if name is None:
+            violations.append(
+                f"{where}: {kind}() metric name is not a string literal "
+                f"— computed names can't be schema-checked")
+            continue
+        if not name.startswith("pio_"):
+            violations.append(
+                f"{where}: metric {name!r} missing the pio_ prefix "
+                f"(naming convention: pio_<subsystem>_<what>_<unit>)")
+        labels = _literal_labelnames(labels_node)
+        if labels is None:
+            violations.append(
+                f"{where}: metric {name!r} labelnames are not a literal "
+                f"tuple of strings")
+            continue
+        bucket_key = None
+        if kind == "histogram":
+            bucket_key = _bucket_spec(buckets_node)
+            if bucket_key is None:
+                violations.append(
+                    f"{where}: histogram {name!r} buckets are computed at "
+                    f"runtime — declare a literal tuple or an UPPERCASE "
+                    f"module constant so every instance shares one "
+                    f"bucket schema")
+        prev = registry.get(name)
+        if prev is None:
+            registry[name] = {"kind": kind, "labels": labels,
+                              "buckets": bucket_key, "where": where}
+            continue
+        if prev["kind"] != kind:
+            violations.append(
+                f"{where}: metric {name!r} registered as {kind} but "
+                f"already a {prev['kind']} at {prev['where']}")
+        if prev["labels"] != labels:
+            violations.append(
+                f"{where}: metric {name!r} registered with labels "
+                f"{labels} but {prev['labels']} at {prev['where']} — one "
+                f"(name, label-set) schema per metric")
+        if (kind == "histogram" and bucket_key is not None
+                and prev.get("buckets") is not None
+                and prev["buckets"] != bucket_key):
+            violations.append(
+                f"{where}: histogram {name!r} buckets {bucket_key} differ "
+                f"from {prev['buckets']} at {prev['where']}")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    pkg = root / "predictionio_tpu"
+    registry: Dict[str, Dict] = {}
+    violations: List[str] = []
+    for path in sorted(pkg.rglob("*.py")):
+        violations.extend(check_source(
+            path.read_text(encoding="utf-8"), str(path), registry))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} metrics-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_metrics: every metric is pio_-prefixed, literally named, "
+          "and schema-consistent.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

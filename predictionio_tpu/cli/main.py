@@ -251,6 +251,9 @@ def _print_serving_snapshot(lines) -> None:
     breakers = {}
     watchdog = {}
     batcher = {}
+    latest_ts = {}
+    staleness = None
+    refresh_runs = {}
 
     def _b(model):
         return batcher.setdefault(model, {})
@@ -258,6 +261,12 @@ def _print_serving_snapshot(lines) -> None:
     for name, labels, value in _parse_metric_lines(lines):
         if name == "pio_model_generation":
             generation = int(value)
+        elif name == "pio_events_latest_ts":
+            latest_ts[labels.get("app", "?")] = value
+        elif name == "pio_refresh_staleness_s":
+            staleness = value
+        elif name == "pio_refresh_runs_total" and value > 0:
+            refresh_runs[labels.get("result", "?")] = int(value)
         elif name == "pio_model_reload_total":
             reloads[labels.get("result", "?")] = int(value)
         elif name == "pio_breaker_state":
@@ -280,10 +289,22 @@ def _print_serving_snapshot(lines) -> None:
         elif name == "pio_queue_shed_total" and value > 0:
             shed = _b(labels.get("model", "?")).setdefault("shed", {})
             shed[labels.get("reason", "?")] = int(value)
-    if generation is None and not reloads and not breakers and not batcher:
+    if generation is None and not reloads and not breakers and not batcher \
+            and not latest_ts and not refresh_runs and staleness is None:
         return
     if generation is not None:
         print(f"serving: model generation {generation}")
+    # Freshness (ISSUE 10): ingest high-watermark per app + the refresh
+    # loop's event→servable staleness, when the scraped process runs it.
+    for app, ts in sorted(latest_ts.items()):
+        iso = _dt.datetime.fromtimestamp(
+            ts, tz=_dt.timezone.utc).isoformat(timespec="seconds")
+        print(f"  events latest [app {app}]: {iso}")
+    if staleness is not None:
+        print(f"  refresh staleness: {staleness:g}s event→servable")
+    if refresh_runs:
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(refresh_runs.items()))
+        print(f"  refresh runs: {parts}")
     if reloads:
         parts = ", ".join(f"{k}={v}" for k, v in sorted(reloads.items()))
         print(f"  model reloads: {parts}")
@@ -519,6 +540,8 @@ def cmd_train(args) -> int:
     ctx = RuntimeContext.create(seed=args.seed, mesh_spec=args.mesh)
     if ctx.mesh is not None:
         print(f"Mesh: {dict(ctx.mesh.shape)} over {ctx.mesh.devices.size} device(s)")
+    if getattr(args, "follow", False):
+        return _train_follow(args, engine, variant, ctx)
     try:
         instance_id = run_train(engine, variant, ctx)
     except TrainPreempted as e:
@@ -527,6 +550,58 @@ def cmd_train(args) -> int:
               file=sys.stderr)
         return PREEMPTED_EXIT_CODE
     print(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def _train_follow(args, engine, variant, ctx) -> int:
+    """`pio train --follow` (ISSUE 10): the continuous-refresh daemon.
+
+    Retrains on a cadence — delta warm-start when the last generation
+    carries a watermark and continuable state, full retrain otherwise —
+    and, with --promote-url / PIO_REFRESH_PROMOTE_URL, promotes each
+    generation through the serving server's staged-reload canary gate
+    (rolling back if the SLO burn trips inside the canary window).
+    SIGTERM/SIGINT stop the loop; one mid-train exits with the
+    preemption contract (checkpoint + exit 143) like any other train."""
+    import signal
+
+    from predictionio_tpu.refresh import RefreshConfig
+    from predictionio_tpu.refresh.daemon import RefreshDaemon
+    from predictionio_tpu.resilience.supervision import (
+        PREEMPTED_EXIT_CODE,
+        TrainPreempted,
+        request_preemption,
+    )
+
+    cfg = RefreshConfig.from_env(
+        interval_s=getattr(args, "refresh_interval", None),
+        promote_url=getattr(args, "promote_url", None),
+        canary_window_s=getattr(args, "canary_window", None),
+    )
+    daemon = RefreshDaemon(engine, variant, ctx, config=cfg)
+
+    def _stop(signum, frame):
+        print(f"[follow] signal {signum}: stopping after the current "
+              "cycle (mid-train: checkpoint + resume semantics apply)",
+              file=sys.stderr)
+        request_preemption()   # an in-flight train checkpoints and exits
+        daemon.stop()          # the between-cycles wait wakes immediately
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _stop)
+        except (ValueError, OSError):
+            continue
+    where = f", promoting via {cfg.promote_url}" if cfg.promote_url else \
+        " (no promote URL — serving servers reload on their own)"
+    print(f"Refresh daemon: retraining every {cfg.interval_s:g}s{where}. "
+          "Ctrl-C to stop.")
+    try:
+        cycles = daemon.follow()
+    except TrainPreempted as e:
+        print(f"[preempted] {e}", file=sys.stderr)
+        return PREEMPTED_EXIT_CODE
+    print(f"Refresh daemon stopped after {cycles} cycle(s).")
     return 0
 
 
@@ -1229,6 +1304,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "once fusion depth caps out — fewer, wider "
                         "optimizer steps: a semantics change, opt-in "
                         "(env PIO_BATCH_AUTOSCALE=on)")
+    t.add_argument("--follow", action="store_true",
+                   help="continuous refresh: retrain on a cadence "
+                        "(delta warm-start when possible), promote "
+                        "through the serving server's staged-reload "
+                        "canary gate (--promote-url), roll back on SLO "
+                        "burn; Ctrl-C/SIGTERM stops")
+    t.add_argument("--refresh-interval", dest="refresh_interval",
+                   type=float, default=None, metavar="S",
+                   help="follow-mode cadence in seconds (default env "
+                        "PIO_REFRESH_INTERVAL_S, else 300)")
+    t.add_argument("--promote-url", dest="promote_url", default=None,
+                   metavar="URL",
+                   help="engine-server base URL each refreshed "
+                        "generation is promoted through (POST /reload → "
+                        "staged canary gate; default env "
+                        "PIO_REFRESH_PROMOTE_URL; unset = train only)")
+    t.add_argument("--canary-window", dest="canary_window", type=float,
+                   default=None, metavar="S",
+                   help="post-promotion SLO-burn watch window; a trip "
+                        "rolls the promotion back (default env "
+                        "PIO_REFRESH_CANARY_WINDOW_S, else 60; 0 = off)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="evaluate engine-params candidates")

@@ -17,6 +17,7 @@ from predictionio_tpu.controller.base import (
     Preparator,
     RuntimeContext,
     Serving,
+    WarmStartFallback,
     model_from_bytes,
     model_to_bytes,
 )
@@ -66,6 +67,7 @@ __all__ = [
     "RuntimeContext",
     "Serving",
     "SumMetric",
+    "WarmStartFallback",
     "ZeroMetric",
     "bind_params",
     "load_engine_factory",

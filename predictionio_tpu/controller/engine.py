@@ -126,14 +126,45 @@ class Engine:
         return self.serving_class(engine_params.serving_params)
 
     # -- train / eval drive (reference: Engine.train / Engine.eval) --------
-    def train(self, ctx: RuntimeContext, engine_params: EngineParams) -> List[Any]:
+    def train(self, ctx: RuntimeContext, engine_params: EngineParams,
+              warm: Any = None) -> List[Any]:
         """Run DataSource → Preparator → each Algorithm.train; returns models.
 
         Each DASE stage is a named observability phase: a span in the
         enclosing ``run_train`` trace and a ``pio_train_phase_ms`` series.
+
+        With ``warm`` (a :class:`~predictionio_tpu.refresh.
+        WarmStartContext`; ISSUE 10), the datasource reads through the
+        caller's delta-scoped event store and every algorithm continues
+        its previous model via :meth:`Algorithm.warm_start` instead of
+        :meth:`Algorithm.train`.  Any algorithm raising
+        :class:`~predictionio_tpu.controller.WarmStartFallback` aborts the
+        WHOLE warm attempt (one generation must be one consistent data
+        window — a mixed warm/full model set would serve models trained
+        on different corpora); ``run_train`` then retrains fully.
         """
         from predictionio_tpu.obs import phase
 
+        names = [n for n, _ in engine_params.algorithms_params]
+        if warm is not None:
+            from predictionio_tpu.controller.base import (
+                Algorithm as _AlgoBase,
+                WarmStartFallback,
+            )
+
+            if len(warm.models) != len(names):
+                raise WarmStartFallback(
+                    f"algorithm set changed ({len(warm.models)} previous "
+                    f"model(s) vs {len(names)} configured)")
+            # Decline BEFORE the datasource read: an engine whose
+            # algorithms all use the declining default (e.g. ALS) would
+            # otherwise pay a full delta read+prepare every refresh
+            # cycle just to be told no.
+            if all(cls.warm_start is _AlgoBase.warm_start
+                   for cls in self.algorithm_classes.values()):
+                raise WarmStartFallback(
+                    "no configured algorithm supports warm-start "
+                    "continuation")
         datasource = self.datasource_class(engine_params.datasource_params)
         preparator = self.preparator_class(engine_params.preparator_params)
         with phase("train.datasource"):
@@ -141,10 +172,15 @@ class Engine:
         with phase("train.prepare"):
             pd = preparator.prepare(ctx, td)
         models = []
-        names = [n for n, _ in engine_params.algorithms_params]
-        for name, algo in zip(names, self.make_algorithms(engine_params)):
-            with phase("train.algorithm", algo=name):
-                models.append(algo.train(ctx, pd))
+        for i, (name, algo) in enumerate(
+                zip(names, self.make_algorithms(engine_params))):
+            if warm is not None:
+                with phase("train.algorithm.warm", algo=name):
+                    models.append(
+                        algo.warm_start(ctx, pd, warm.models[i], warm))
+            else:
+                with phase("train.algorithm", algo=name):
+                    models.append(algo.train(ctx, pd))
         return models
 
     def eval(

@@ -423,6 +423,24 @@ class Events(abc.ABC):
                                        app_id, channel_id))
         return n
 
+    def latest_event_time(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[_dt.datetime]:
+        """The ingest high-watermark: the newest ``event_time`` stored for
+        the app/channel, or None when empty.
+
+        This is THE freshness anchor of the online-learning loop
+        (ISSUE 10): the event server exports it as
+        ``pio_events_latest_ts{app}`` and the refresh daemon compares it
+        against the serving generation's data watermark to compute
+        event→servable staleness.  Default implementation reads one
+        event via the reversed ordered scan; backends override with an
+        O(1)/indexed query.
+        """
+        for ev in self.find(app_id, channel_id, limit=1, reversed=True):
+            return ev.event_time
+        return None
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -266,9 +266,17 @@ def _match(
     target_entity_type,
     target_entity_id,
 ) -> bool:
-    if start_time is not None and ev.event_time < start_time:
+    # Compare in epoch micros with the shared naive-datetime-is-UTC rule
+    # (base.epoch_us) — the sqlite/parquet backends filter on converted
+    # integers, so a naive bound against an aware event time must mean
+    # the same instant here too, not raise or shift by the local zone.
+    # Boundary contract (pinned by tests/test_storage_contract.py):
+    # start_time INCLUSIVE, until_time EXCLUSIVE.
+    if start_time is not None and \
+            base.epoch_us(ev.event_time) < base.epoch_us(start_time):
         return False
-    if until_time is not None and ev.event_time >= until_time:
+    if until_time is not None and \
+            base.epoch_us(ev.event_time) >= base.epoch_us(until_time):
         return False
     if entity_type is not None and ev.entity_type != entity_type:
         return False
@@ -345,7 +353,21 @@ class MemoryEvents(base.Events):
                 event_names, target_entity_type, target_entity_id,
             )
         ]
-        evs.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed)
+        # Same ordering key as sqlite's `ORDER BY eventtime, creationtime`
+        # — through epoch_us so naive and aware stamps interleave by
+        # instant instead of raising on comparison.
+        evs.sort(key=lambda e: (base.epoch_us(e.event_time),
+                                base.epoch_us(e.creation_time) or 0),
+                 reverse=reversed)
         if limit is not None and limit >= 0:
             evs = evs[:limit]
         return iter(evs)
+
+    def latest_event_time(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[_dt.datetime]:
+        bucket = self._bucket(app_id, channel_id)
+        if not bucket:
+            return None
+        return max((e.event_time for e in bucket.values()),
+                   key=base.epoch_us)

@@ -253,6 +253,23 @@ class ParquetEvents(base.Events):
             table = table.select(list(columns))
         return table
 
+    def latest_event_time(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[_dt.datetime]:
+        """Ingest high-watermark: columnar MAX over the projected
+        event_time_us column — no row materialization, no sort."""
+        d = self._check_init(app_id, channel_id)
+        with self._lock:
+            table = self._scan(d, app_id, channel_id,
+                               columns=["event_time_us"])
+        if table is None or table.num_rows == 0:
+            return None
+        us = pc.max(table["event_time_us"]).as_py()
+        if us is None:
+            return None
+        return _dt.datetime.fromtimestamp(us / 1_000_000,
+                                          tz=_dt.timezone.utc)
+
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         d = self._check_init(app_id, channel_id)
         with self._lock:

@@ -171,7 +171,7 @@ def _recv(sock: socket.socket, max_len: Optional[int] = None) -> Any:
 # private/backing methods).
 _ALLOWED = {
     "events": {"init", "remove", "insert", "insert_batch", "get", "delete",
-               "find"},
+               "find", "latest_event_time"},
     "apps": {"insert", "get", "get_by_name", "get_all", "update", "delete"},
     "access_keys": {"insert", "get", "get_all", "get_by_app_id", "update",
                     "delete"},
@@ -683,6 +683,9 @@ class RemoteEvents(Events):
     insert_batch = _forward("events", "insert_batch")
     get = _forward("events", "get")
     delete = _forward("events", "delete")
+    # One RPC to the backend's indexed MAX — the base-class default would
+    # stream a whole reversed find page for one timestamp.
+    latest_event_time = _forward("events", "latest_event_time")
 
     def find(self, *args, **kwargs):
         # Streams via server-side cursor pages — never materializes the

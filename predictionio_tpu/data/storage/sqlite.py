@@ -599,6 +599,21 @@ class SQLiteEvents(_Repo, base.Events):
             )
             return cur.rowcount > 0
 
+    def latest_event_time(
+        self, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[_dt.datetime]:
+        """Ingest high-watermark: one indexed MAX over (appid, channelid,
+        eventtime) — the freshness anchor must stay O(log n), it is
+        polled per ingest batch and per refresh cycle."""
+        self._check_init(app_id, channel_id)
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT MAX(eventtime) FROM {self._ns}_events "
+                "WHERE appid=? AND channelid IS ?",
+                (app_id, channel_id),
+            ).fetchone()
+        return _dt_from(row[0]) if row and row[0] is not None else None
+
     def _where(
         self, app_id, channel_id, start_time, until_time, entity_type, entity_id,
         event_names, target_entity_type, target_entity_id,

@@ -17,7 +17,8 @@ import pyarrow as pa
 from predictionio_tpu.data.event import Event, PropertyMap
 from predictionio_tpu.data.storage import Storage, StorageError
 
-__all__ = ["EventStore", "PEventStore", "LEventStore"]
+__all__ = ["EventStore", "PEventStore", "LEventStore",
+           "WindowedEventStore"]
 
 
 class EventStore:
@@ -176,6 +177,77 @@ class EventStore:
                 reversed=latest,
             )
         )
+
+
+    def latest_event_time(
+        self, app_name: str, channel_name: Optional[str] = None
+    ) -> Optional[_dt.datetime]:
+        """Ingest high-watermark by app NAME (the freshness anchor the
+        refresh daemon compares against the serving generation's data
+        watermark — ISSUE 10)."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self._storage.get_events().latest_event_time(
+            app_id, channel_id)
+
+
+class WindowedEventStore(EventStore):
+    """An :class:`EventStore` view scoped to one training data window.
+
+    The online-refresh loop (ISSUE 10) pins every train run to an
+    explicit ``(start_time, until_time]``-style window so consecutive
+    generations never gap or overlap: ``run_train`` stamps the watermark
+    BEFORE the datasource reads, wraps ``ctx.event_store`` in this view,
+    and records the bound on the EngineInstance.  DataSources need no
+    changes — any read that does not pass its own ``start_time`` /
+    ``until_time`` inherits the window (an explicit caller bound inside
+    the window is narrower and kept; one outside it is clamped so a
+    datasource can never read past its generation's watermark).
+    """
+
+    def __init__(self, storage: Storage,
+                 start_time: Optional[_dt.datetime],
+                 until_time: Optional[_dt.datetime]):
+        super().__init__(storage)
+        self.window_start = start_time
+        self.window_until = until_time
+
+    def _clamped(self, kwargs: dict, *, inject_start: bool = True) -> dict:
+        from predictionio_tpu.data.storage.base import epoch_us
+
+        st = kwargs.get("start_time")
+        if st is None:
+            st = self.window_start if inject_start else None
+        elif self.window_start is not None and inject_start \
+                and epoch_us(st) < epoch_us(self.window_start):
+            st = self.window_start
+        ut = kwargs.get("until_time")
+        if ut is None:
+            ut = self.window_until
+        elif self.window_until is not None \
+                and epoch_us(ut) > epoch_us(self.window_until):
+            ut = self.window_until
+        out = dict(kwargs)
+        out["start_time"] = st
+        out["until_time"] = ut
+        return out
+
+    def find_columnar(self, app_name, channel_name=None, **kwargs):
+        return super().find_columnar(app_name, channel_name,
+                                     **self._clamped(kwargs))
+
+    def find(self, app_name, channel_name=None, **kwargs):
+        return super().find(app_name, channel_name, **self._clamped(kwargs))
+
+    def aggregate_properties(self, app_name, entity_type, channel_name=None,
+                             **kwargs):
+        # $set/$unset/$delete property state is CUMULATIVE from t=0 — a
+        # delta-windowed aggregation would drop every property written
+        # before the window and hand the trainer phantom-empty entities.
+        # Only the until bound applies (the generation still must not
+        # see past its watermark); the window start is never injected.
+        return super().aggregate_properties(
+            app_name, entity_type, channel_name,
+            **self._clamped(kwargs, inject_start=False))
 
 
 # Reference-vocabulary aliases: both stores are views of the same class.

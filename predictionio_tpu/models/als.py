@@ -50,7 +50,8 @@ from predictionio_tpu.ops.topk import chunked_top_k, top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, put_sharded
 
 __all__ = ["ALSConfig", "ALSModel", "ALSInputs", "prepare_als_inputs",
-           "train_als", "train_als_prepared", "recommend", "predict_scores"]
+           "train_als", "train_als_prepared", "recommend", "predict_scores",
+           "fold_in"]
 
 
 @dataclasses.dataclass
@@ -1321,6 +1322,51 @@ def recommend(
         return chunked_top_k(q, model.item_factors, k, chunk=chunk)
     return _recommend_impl(model.user_factors, model.item_factors,
                            user_indices, seen, k=k)
+
+
+def fold_in(
+    item_factors: np.ndarray,     # [I, K] host item factors (frozen)
+    item_ids: np.ndarray,         # [d] int — the user's observed items
+    ratings: np.ndarray,          # [d] float — ratings / implicit strengths
+    *,
+    reg: float,
+    alpha: float = 1.0,
+    implicit: bool = False,
+    yty: Optional[np.ndarray] = None,   # [K, K] — required when implicit
+) -> np.ndarray:
+    """Serve-time ALS fold-in: one ridge solve for an UNSEEN user against
+    the frozen item factors (ISSUE 10).
+
+    This is exactly the user-side normal equation the training sweep
+    solves (:func:`_gram_pieces` semantics, ALS-WR ``λ·n_u`` ridge;
+    implicit = Hu-Koren-Volinsky with the shared ``YᵀY`` term passed in
+    by the caller, cached per generation), in host numpy — rank is tens
+    and degree is a visitor's recent-event count, so one K×K solve is
+    microseconds and the serving path never pays a device dispatch.
+    The folded factor is per-process and ephemeral by design: the next
+    refresh trains the user in and makes it durable.
+    """
+    item_ids = np.asarray(item_ids, np.int64)
+    r = np.asarray(ratings, np.float64)
+    y = np.asarray(item_factors, np.float64)[item_ids]      # [d, K]
+    k = y.shape[1]
+    if implicit:
+        if yty is None:
+            raise ValueError("implicit fold_in needs the cached YᵀY")
+        w = alpha * np.abs(r)                               # c - 1
+        c = (1.0 + w) * (r > 0)
+        a = np.asarray(yty, np.float64) + (y * w[:, None]).T @ y
+        b = y.T @ c
+    else:
+        a = y.T @ y
+        b = y.T @ r
+    n = max(len(item_ids), 1)
+    a = a + reg * n * np.eye(k)
+    try:
+        u = np.linalg.solve(a, b)
+    except np.linalg.LinAlgError:                       # singular corner:
+        u = np.linalg.lstsq(a, b, rcond=None)[0]        # degenerate events
+    return u.astype(np.float32)
 
 
 def rmse(model: ALSModel, user_ids, item_ids, ratings) -> float:

@@ -312,8 +312,14 @@ def train(
     save_every: int = 0,
     data_source: str = "auto",
     fuse_steps=None,
+    warm_state: Optional[DLRMState] = None,
 ) -> DLRMState:
     """Minibatch CTR training.
+
+    ``warm_state`` (ISSUE 10): continue from an existing state (the
+    previous generation's) on a delta window instead of a fresh init —
+    DLRM's hashed vocabularies are fixed-size, so no table growth is
+    needed and any unseen entity already lands in a shared bucket.
 
     ``data_source`` mirrors two_tower.train: "feeder" streams batches
     from the native mmap cache (v3: any number of categorical columns —
@@ -351,7 +357,8 @@ def train(
                                   checkpoint_dir=checkpoint_dir,
                                   save_every=save_every,
                                   data_source=data_source, guard=guard,
-                                  fuse_steps=fuse_steps)
+                                  fuse_steps=fuse_steps,
+                                  warm_state=warm_state)
         except RollbackRequested:
             continue  # re-enter: restore_step fast-forwards to last-good
 
@@ -368,6 +375,7 @@ def _train_attempt(
     data_source: str,
     guard,
     fuse_steps=None,
+    warm_state: Optional[DLRMState] = None,
 ) -> DLRMState:
     from predictionio_tpu.resilience.supervision import (
         StepWatchdog,
@@ -379,11 +387,15 @@ def _train_attempt(
     n = len(labels)
     cat = np.asarray(cat)
     cat_global = (np.asarray(cat, np.int64) + cfg.offsets[None, :]).astype(np.int32)
-    state = init_state(cfg, mesh)
+    state = warm_state if warm_state is not None else init_state(cfg, mesh)
     total_steps = cfg.epochs * ((n + cfg.batch_size - 1) // cfg.batch_size)
+    # Warm continuations fingerprint on the carried step: a crash-resume
+    # checkpoint from a different base generation must not restore here.
+    fp_extra = f"|warm@{int(jax.device_get(state.step))}" \
+        if warm_state is not None else ""
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
                              if checkpoint_dir else 0,
-                             fingerprint=f"dlrm|{cfg}|n={n}")
+                             fingerprint=f"dlrm|{cfg}|n={n}{fp_extra}")
     watchdog = StepWatchdog("dlrm", checkpoint_fn=ckpt.flush)
     start_step = ckpt.restore_step(
         (state.params, state.opt_state, state.step), total_steps=total_steps)

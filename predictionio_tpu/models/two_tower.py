@@ -34,8 +34,8 @@ from predictionio_tpu.ops.topk import top_k_scores
 from predictionio_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, put_sharded
 
 __all__ = ["TwoTowerConfig", "TwoTowerState", "init_state", "train_step",
-           "train_steps_fused", "train", "encode_users", "encode_items",
-           "retrieve"]
+           "train_steps_fused", "train", "grow_state", "state_to_host",
+           "state_from_host", "encode_users", "encode_items", "retrieve"]
 
 
 @dataclasses.dataclass
@@ -109,6 +109,69 @@ def init_state(cfg: TwoTowerConfig, mesh: Optional[Mesh] = None) -> TwoTowerStat
     opt_state = _tx(cfg).init(params)
     return TwoTowerState(params=params, opt_state=opt_state,
                          step=jnp.zeros((), jnp.int32))
+
+
+def state_to_host(state: TwoTowerState) -> Dict:
+    """Host-numpy snapshot of a train state for persistence inside a
+    model wrapper (the warm-start carry of ISSUE 10).  Exact f32 values —
+    the round-trip is bitwise (test-pinned), so a warm-started
+    continuation equals continuing in-process."""
+    params, opt_state, step = jax.device_get(
+        (state.params, state.opt_state, state.step))
+    to_np = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+    return {"params": to_np(params), "opt_state": to_np(opt_state),
+            "step": np.asarray(step)}
+
+
+def state_from_host(snapshot: Dict) -> TwoTowerState:
+    """Rebuild a live state from :func:`state_to_host` output.  Leaves
+    stay host-backed numpy; the first dispatch uploads them."""
+    return TwoTowerState(
+        params=jax.tree.map(jnp.asarray, snapshot["params"]),
+        opt_state=jax.tree.map(jnp.asarray, snapshot["opt_state"]),
+        step=jnp.asarray(snapshot["step"]))
+
+
+def grow_state(state: TwoTowerState, cfg: TwoTowerConfig) -> TwoTowerState:
+    """Grow the embedding tables for entities first seen in a delta
+    window (warm-start refresh, ISSUE 10).
+
+    Existing rows keep their trained values AND their adam moments; new
+    rows get a fresh deterministic init (keyed off ``cfg.seed`` and the
+    CURRENT table height, so two refreshes growing by different deltas
+    never collide on init noise) with zero moments — exactly what a
+    cold table row would have seen.  ``cfg`` carries the NEW
+    ``n_users``/``n_items``.
+    """
+    params = dict(state.params)
+    scale = cfg.embed_dim ** -0.5
+
+    def grown(table: jax.Array, n_total: int, salt: int) -> jax.Array:
+        n_old = table.shape[0]
+        if n_total <= n_old:
+            return table
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed),
+                                 salt * 1_000_003 + n_old)
+        fresh = jax.random.normal(
+            key, (n_total - n_old, cfg.embed_dim)) * scale
+        return jnp.concatenate([table, fresh], axis=0)
+
+    params["user_embed"] = grown(params["user_embed"], cfg.n_users, 1)
+    params["item_embed"] = grown(params["item_embed"], cfg.n_items, 2)
+    # Optimizer moments: a fresh init for the new shapes gives zeroed
+    # slots everywhere; copy the old leaves back in (same-shape leaves
+    # whole, row-grown tables as a prefix write).
+    fresh_opt = _tx(cfg).init(params)
+
+    def merge(old_leaf, fresh_leaf):
+        old_leaf = jnp.asarray(old_leaf)
+        if old_leaf.shape == jnp.shape(fresh_leaf):
+            return old_leaf
+        return jnp.asarray(fresh_leaf).at[: old_leaf.shape[0]].set(old_leaf)
+
+    opt_state = jax.tree.map(merge, state.opt_state, fresh_opt)
+    return TwoTowerState(params=params, opt_state=opt_state,
+                         step=state.step)
 
 
 def param_shardings(cfg: TwoTowerConfig, mesh: Mesh):
@@ -276,8 +339,18 @@ def train(
     save_every: int = 0,
     data_source: str = "auto",
     fuse_steps=None,
+    warm_state: Optional[TwoTowerState] = None,
 ) -> TwoTowerState:
     """Minibatch training loop over interaction pairs.
+
+    ``warm_state`` (ISSUE 10): continue from an existing state instead of
+    a fresh init — the delta warm-start path.  The state must already
+    match ``cfg``'s table heights (grow via :func:`grow_state` first);
+    ``user_ids``/``item_ids`` then carry only the delta window's
+    interactions.  Identical loop otherwise: same prefetcher, fusion,
+    supervision, and checkpoint semantics ride both modes, and the
+    result is bitwise what in-process continued training on the same
+    batches would produce (test-pinned).
 
     The trailing ragged batch is padded with weight-0 rows — fixed shapes,
     one compilation (SURVEY.md §7 recompilation discipline).  With
@@ -327,7 +400,8 @@ def train(
                                   checkpoint_dir=checkpoint_dir,
                                   save_every=save_every,
                                   data_source=data_source, guard=guard,
-                                  fuse_steps=fuse_steps)
+                                  fuse_steps=fuse_steps,
+                                  warm_state=warm_state)
         except RollbackRequested:
             continue  # re-enter: restore_step fast-forwards to last-good
 
@@ -344,6 +418,7 @@ def _train_attempt(
     data_source: str,
     guard,
     fuse_steps=None,
+    warm_state: Optional[TwoTowerState] = None,
 ) -> TwoTowerState:
     from predictionio_tpu.resilience.supervision import (
         StepWatchdog,
@@ -355,11 +430,16 @@ def _train_attempt(
     n = len(user_ids)
     if weights is None:
         weights = np.ones(n, dtype=np.float32)
-    state = init_state(cfg, mesh)
+    state = warm_state if warm_state is not None else init_state(cfg, mesh)
     total_steps = cfg.epochs * ((n + cfg.batch_size - 1) // cfg.batch_size)
+    # Warm continuations fingerprint on the carried step too: a crash-
+    # resume checkpoint from a DIFFERENT base generation must not be
+    # restored into this delta.
+    fp_extra = f"|warm@{int(jax.device_get(state.step))}" \
+        if warm_state is not None else ""
     ckpt = TrainCheckpointer(checkpoint_dir or ".", save_every=save_every
                              if checkpoint_dir else 0,
-                             fingerprint=f"two_tower|{cfg}|n={n}")
+                             fingerprint=f"two_tower|{cfg}|n={n}{fp_extra}")
     watchdog = StepWatchdog("two_tower", checkpoint_fn=ckpt.flush)
     start_step = ckpt.restore_step(
         (state.params, state.opt_state, state.step), total_steps=total_steps)
@@ -527,6 +607,16 @@ def _train_attempt(
         watchdog.stop()
         ckpt.close()
     return state
+
+
+def eval_loss(params: Dict, user_ids, item_ids, cfg: TwoTowerConfig) -> float:
+    """In-batch sampled-softmax loss of ``params`` on one interaction
+    sample — the warm-start regression gate's comparable scalar (same
+    sample, same temperature, before vs after continuation)."""
+    u = jnp.asarray(np.asarray(user_ids, np.int32))
+    i = jnp.asarray(np.asarray(item_ids, np.int32))
+    w = jnp.ones(u.shape[0], jnp.float32)
+    return float(_loss(params, u, i, w, cfg.temperature))
 
 
 def encode_users(params: Dict, user_ids: jax.Array) -> jax.Array:

@@ -1,0 +1,180 @@
+"""Online learning: the event→model refresh loop (ISSUE 10).
+
+The DASE architecture ingests behavioral events continuously, but until
+this subsystem models only changed on a manual ``pio train``.  This
+package closes the loop:
+
+- **Delta warm-start** — ``run_train(warm_from=...)`` restores the last
+  COMPLETED generation's carried state and continues training on only
+  the delta window of events.  The window is anchored by the **data
+  watermark** every train run records on its EngineInstance
+  (``workflow.core_workflow.data_watermark``): the next refresh reads
+  ``[previous watermark, new watermark)``, so windows never gap or
+  overlap.  Algorithms that cannot continue (ALS) raise
+  :class:`~predictionio_tpu.controller.WarmStartFallback` and the run
+  retrains fully — a cycle always lands a generation.
+- **Serve-time fold-in** — ALS answers UNSEEN users by solving one
+  ridge system against the frozen item factors from the user's recent
+  events (``models.als.fold_in``), cached per generation.  Per-process
+  and ephemeral: the next refresh trains the user in.
+- **Refresh daemon** (:mod:`predictionio_tpu.refresh.daemon`) —
+  ``pio train --follow`` retrains on a cadence, each run supervised by
+  the PR-4 machinery (watchdog / divergence rollback / preemption,
+  which live inside the train loops), promoted ONLY through the engine
+  server's staged-reload canary gate (``POST /reload`` — never a direct
+  model write; ``tools/lint_refresh.py`` pins this), and auto-rolled
+  back if the PR-9 SLO burn trips within the canary window.
+
+Freshness is first-class observability:
+
+====================================  ==================================
+``pio_refresh_runs_total{result}``    refresh cycles by outcome
+                                      (warm / full / full_fallback /
+                                      failed)
+``pio_refresh_promotions_total        staged-reload promotions by
+{result}``                            outcome (promoted / rolled_back /
+                                      rejected / error / skipped)
+``pio_refresh_staleness_s``           event→servable staleness: ingest
+                                      high-watermark minus the promoted
+                                      generation's data watermark
+``pio_refresh_train_s{mode}``         wall seconds of the last refresh
+                                      train by mode
+``pio_events_latest_ts{app}``         (event server) ingest
+                                      high-watermark, epoch seconds
+====================================  ==================================
+
+Env knobs (all read by :meth:`RefreshConfig.from_env`):
+
+====================================  ==================================
+``PIO_REFRESH_INTERVAL_S``            follow-mode cadence (default 300)
+``PIO_REFRESH_MAX_DELTA_FRACTION``    delta/corpus ratio above which a
+                                      warm start falls back to a full
+                                      retrain (default 0.5)
+``PIO_REFRESH_EVAL_TOLERANCE``        allowed relative regression of the
+                                      warm-started model on the delta
+                                      sample before falling back (0.1)
+``PIO_REFRESH_PROMOTE_URL``           engine-server base URL promotions
+                                      go through (unset = train only,
+                                      no promotion)
+``PIO_REFRESH_CANARY_WINDOW_S``       post-promotion SLO watch window
+                                      (default 60; 0 = no watch)
+``PIO_REFRESH_CANARY_POLL_S``         SLO poll cadence in the window (2)
+``PIO_FOLD_IN``                       serve-time ALS fold-in on/off (on)
+``PIO_FOLD_IN_EVENTS``                events per fold-in solve (50)
+``PIO_FOLD_IN_CACHE``                 folded users kept per generation
+                                      (10000)
+====================================  ==================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import os
+from typing import Any, List, Optional
+
+from predictionio_tpu.controller import WarmStartFallback
+from predictionio_tpu.data.storage.base import EngineInstance, epoch_us
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.workflow.core_workflow import (
+    DATA_WATERMARK_KEY,
+    data_watermark,
+)
+
+__all__ = [
+    "RefreshConfig",
+    "WarmStartContext",
+    "WarmStartFallback",
+    "RefreshMetrics",
+    "staleness_s",
+    "data_watermark",
+    "DATA_WATERMARK_KEY",
+]
+
+
+def _env_f(key: str, default: float) -> float:
+    raw = os.environ.get(key)
+    if raw is None or str(raw).strip() == "":
+        return default
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass
+class RefreshConfig:
+    """Refresh-loop knobs; :meth:`from_env` is the production
+    constructor (CLI flags override, same pattern as SchedulerConfig)."""
+
+    interval_s: float = 300.0
+    max_delta_fraction: float = 0.5
+    eval_tolerance: float = 0.1
+    promote_url: Optional[str] = None
+    canary_window_s: float = 60.0
+    canary_poll_s: float = 2.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RefreshConfig":
+        cfg = cls(
+            interval_s=_env_f("PIO_REFRESH_INTERVAL_S", 300.0),
+            max_delta_fraction=_env_f("PIO_REFRESH_MAX_DELTA_FRACTION", 0.5),
+            eval_tolerance=_env_f("PIO_REFRESH_EVAL_TOLERANCE", 0.1),
+            promote_url=(os.environ.get("PIO_REFRESH_PROMOTE_URL") or None),
+            canary_window_s=_env_f("PIO_REFRESH_CANARY_WINDOW_S", 60.0),
+            canary_poll_s=_env_f("PIO_REFRESH_CANARY_POLL_S", 2.0),
+        )
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+        return cfg
+
+
+@dataclasses.dataclass
+class WarmStartContext:
+    """Everything a warm (delta) train run needs about its parent
+    generation.  ``models`` aligns with the engine's algorithm list —
+    ``Algorithm.warm_start`` receives its own previous model."""
+
+    instance: EngineInstance
+    models: List[Any]
+    start_time: _dt.datetime            # parent's data watermark
+    max_delta_fraction: float = 0.5
+    eval_tolerance: float = 0.1
+
+
+def staleness_s(latest_event_time: Optional[_dt.datetime],
+                serving_watermark: Optional[_dt.datetime]) -> Optional[float]:
+    """Event→servable staleness: how far the ingest high-watermark runs
+    ahead of the serving generation's data watermark.  None when either
+    side is unknown (no events yet / pre-ISSUE-10 instance); floored at
+    0 — a watermark past the newest event means everything ingested is
+    already servable."""
+    if latest_event_time is None or serving_watermark is None:
+        return None
+    return max(
+        0.0,
+        (epoch_us(latest_event_time) - epoch_us(serving_watermark)) / 1e6)
+
+
+class RefreshMetrics:
+    """The refresh loop's instruments over the shared registry."""
+
+    def __init__(self, registry=None):
+        reg = registry or get_registry()
+        self.runs = reg.counter(
+            "pio_refresh_runs_total",
+            "Refresh train cycles by outcome (warm/full/full_fallback/"
+            "failed).", ("result",))
+        self.promotions = reg.counter(
+            "pio_refresh_promotions_total",
+            "Refresh promotions through the staged-reload gate by outcome.",
+            ("result",))
+        self.staleness = reg.gauge(
+            "pio_refresh_staleness_s",
+            "Event→servable staleness: ingest high-watermark minus the "
+            "promoted generation's data watermark, seconds.")
+        self.train_s = reg.gauge(
+            "pio_refresh_train_s",
+            "Wall seconds of the last refresh train run by mode.",
+            ("mode",))

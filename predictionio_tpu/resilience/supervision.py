@@ -336,8 +336,18 @@ class DivergenceGuard:
 def _default_abort() -> None:
     """Raise KeyboardInterrupt in the main thread — unwinds ``pio train``
     through its normal teardown.  A runtime hung inside a C call may not
-    honor it; the supervisor's process-level timeout is the backstop."""
+    honor it; ``PIO_STEP_TIMEOUT_KILL`` (below) or the supervisor's
+    process-level timeout is the backstop."""
     _thread.interrupt_main()
+
+
+def _default_kill() -> None:
+    """Hard escalation: SIGKILL this process.  The soft abort above
+    cannot unwind a runtime wedged inside a C call (libtpu collective,
+    stuck RPC) — interrupt_main only fires when the interpreter next
+    runs bytecode.  By the time this runs the checkpoint flush already
+    happened at fire time, so the kill loses nothing a resume needs."""
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 class StepWatchdog:
@@ -352,16 +362,29 @@ class StepWatchdog:
     checkpointer's flush, making the resume point durable), then
     ``abort_fn`` aborts the run instead of letting it hang forever.
 
-    ``clock`` / ``abort_fn`` / ``checkpoint_fn`` are injectable and
-    :meth:`poll` is public, so the fault matrix drives expiry on a fake
-    clock with no wall sleeps.  The background poller thread starts
-    lazily on the first :meth:`arm` (never when disabled, or when
+    **Hard escalation** (opt-in, ``PIO_STEP_TIMEOUT_KILL`` = grace
+    seconds): the soft abort raises KeyboardInterrupt in the main
+    thread, which a runtime wedged inside a C call (libtpu collective,
+    hung RPC) never observes — the carried-forward ROADMAP gap.  With a
+    kill grace set, the poller keeps watching after a fire; if the run
+    has not unwound (reached :meth:`stop`) within the grace, it
+    escalates to ``kill_fn`` (default: SIGKILL self).  The fire-time
+    checkpoint flush already made the resume point durable, so the kill
+    trades a clean traceback for actually releasing the supervisor.
+
+    ``clock`` / ``abort_fn`` / ``checkpoint_fn`` / ``kill_fn`` are
+    injectable and :meth:`poll` is public, so the fault matrix drives
+    expiry AND escalation on a fake clock with no wall sleeps and no
+    real signals.  The background poller thread starts lazily on the
+    first :meth:`arm` (never when disabled, or when
     ``poll_interval_s <= 0``)."""
 
     def __init__(self, fn: str, timeout_s: Optional[float] = None, *,
                  clock: Callable[[], float] = time.monotonic,
                  checkpoint_fn: Optional[Callable[[], None]] = None,
                  abort_fn: Callable[[], None] = _default_abort,
+                 kill_grace_s: Optional[float] = None,
+                 kill_fn: Callable[[], None] = _default_kill,
                  poll_interval_s: Optional[float] = None,
                  registry=None, timeline=None):
         if timeout_s is None:
@@ -369,11 +392,21 @@ class StepWatchdog:
                 timeout_s = float(os.environ.get("PIO_STEP_TIMEOUT_S", "0"))
             except ValueError:
                 timeout_s = 0.0
+        if kill_grace_s is None:
+            try:
+                kill_grace_s = float(
+                    os.environ.get("PIO_STEP_TIMEOUT_KILL", "0"))
+            except ValueError:
+                kill_grace_s = 0.0
         self.fn = fn
         self.timeout_s = float(timeout_s)
+        self.kill_grace_s = float(kill_grace_s)
         self._clock = clock
         self._checkpoint_fn = checkpoint_fn
         self._abort_fn = abort_fn
+        self._kill_fn = kill_fn
+        self._fired_at: Optional[float] = None
+        self._killed = False
         if poll_interval_s is None:
             poll_interval_s = min(1.0, self.timeout_s / 4) \
                 if self.timeout_s > 0 else 0.0
@@ -413,19 +446,54 @@ class StepWatchdog:
             self._armed = None
 
     def poll(self) -> bool:
-        """Check the armed deadline; fire (once) when expired."""
+        """Check the armed deadline; fire (once) when expired.  After a
+        fire, keep watching for the opt-in hard escalation: a run that
+        has not unwound (stopped this watchdog) within
+        ``kill_grace_s`` of the fire is wedged past what the soft abort
+        can reach — ``kill_fn`` it."""
         with self._lock:
             if self._armed is None:
-                return False
-            step, deadline = self._armed
-            if self._clock() < deadline:
-                return False
-            self._armed = None  # consume: fire exactly once per arm
+                if (self._fired_at is not None and self.kill_grace_s > 0
+                        and not self._killed
+                        and self._clock() - self._fired_at
+                        >= self.kill_grace_s):
+                    self._killed = True
+                else:
+                    return False
+                escalate = True
+            else:
+                step, deadline = self._armed
+                if self._clock() < deadline:
+                    return False
+                self._armed = None  # consume: fire exactly once per arm
+                escalate = False
+        if escalate:
+            self._escalate()
+            return True
         self._fire(step)
         return True
 
+    def _escalate(self) -> None:
+        self._kill_counter().inc(fn=self.fn)
+        publish_event("watchdog.killed", fn=self.fn,
+                      graceS=self.kill_grace_s)
+        logger.critical(
+            "%s: run did not unwind within PIO_STEP_TIMEOUT_KILL=%.1fs of "
+            "the watchdog abort (runtime wedged in a C call?) — hard-"
+            "killing the process; the fire-time checkpoint flush is the "
+            "resume point", self.fn, self.kill_grace_s)
+        self._kill_fn()
+
+    def _kill_counter(self):
+        return (self._registry or get_registry()).counter(
+            "pio_watchdog_killed_total",
+            "Hard kills after a fired watchdog failed to unwind within "
+            "PIO_STEP_TIMEOUT_KILL.", ("fn",))
+
     def _fire(self, step: int) -> None:
         self.fired_steps.append(step)
+        with self._lock:
+            self._fired_at = self._clock()
         self._counter().inc(fn=self.fn)
         from predictionio_tpu.obs.runtime import get_timeline
 
@@ -471,6 +539,10 @@ class StepWatchdog:
 
     def stop(self, timeout: float = 2.0) -> None:
         self._stop.set()
+        with self._lock:
+            # Reaching stop() IS the unwind the kill escalation waits
+            # for — the abort worked, stand down.
+            self._fired_at = None
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)

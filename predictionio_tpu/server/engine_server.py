@@ -64,6 +64,7 @@ from predictionio_tpu.serving import (
 from predictionio_tpu.version import __version__
 from predictionio_tpu.workflow.core_workflow import (
     WorkflowError,
+    data_watermark,
     instance_engine_params,
     load_models,
 )
@@ -561,6 +562,7 @@ class EngineServer:
                     loaded = self._loaded_at
                     gen = self._generation
                     prev = self._previous
+                wm = data_watermark(inst) if inst else None
                 return 200, {
                     "status": "alive",
                     "engineFactory": self.variant.engine_factory,
@@ -568,6 +570,13 @@ class EngineServer:
                     "engineInstanceId": inst.id if inst else None,
                     "modelLoadedAt": loaded.isoformat() if loaded else None,
                     "modelGeneration": gen,
+                    # ISSUE 10: the served generation's data high-
+                    # watermark — events before this instant are in the
+                    # model; the gap to pio_events_latest_ts is the
+                    # event→servable staleness.
+                    "dataWatermark": wm.isoformat() if wm else None,
+                    "refreshMode": (inst.env or {}).get("refreshMode")
+                    if inst else None,
                     "lastReload": self._last_reload or None,
                     "rollbackAvailable": prev is not None,
                     "retainPreviousTtlS": self._retain_ttl_s or None,
@@ -602,9 +611,14 @@ class EngineServer:
                 return 200, self.stats.registry.render(
                     exemplars=param_bool(params, "exemplars"))
             if path == "/stats.json" and method == "GET":
+                with self._swap_lock:
+                    inst = self._instance
+                wm = data_watermark(inst) if inst else None
                 return 200, {**self.stats.snapshot(),
                              "batcher": self.scheduler.snapshot(),
-                             "slo": self.slo.snapshot()}
+                             "slo": self.slo.snapshot(),
+                             "dataWatermark": wm.isoformat() if wm
+                             else None}
             if path == "/traces.json" and method == "GET":
                 # ?request_id= resolves waterfall exemplars to ONE trace;
                 # ?min_ms=/?limit= bound the view (shared helper).

@@ -93,6 +93,16 @@ class _EventMetrics:
         self.latency = self.registry.histogram(
             "pio_event_request_latency_ms",
             "Event API request handling latency.")
+        # Ingest high-watermark (ISSUE 10): the newest event_time STORED
+        # per app, epoch seconds — the freshness anchor the refresh
+        # daemon/`pio status` compare against the serving generation's
+        # data watermark.  Spilled (202) events do not advance it until
+        # replay lands them: the gauge tracks what is *servable from the
+        # store*, not what was accepted.
+        self.latest_ts = self.registry.gauge(
+            "pio_events_latest_ts",
+            "Newest stored event_time per app (ingest high-watermark), "
+            "epoch seconds.", ("app",))
 
     def record(self, status: int, event_name: Optional[str], ms: float) -> None:
         self.requests.inc(status=str(status))
@@ -161,6 +171,11 @@ class EventServer:
             "pio_deadline_shed_total",
             "Requests shed with 504 because their deadline expired.",
             ("server",))
+        # Per-app ingest high-watermark cache behind pio_events_latest_ts
+        # (seeded from the store on an app's first insert, then advanced
+        # in memory — one MAX query per app per process, not per event).
+        self._latest_ts: Dict[int, int] = {}
+        self._latest_lock = threading.Lock()
         self.spill: Optional[SpillJournal] = None
         self._replay: Optional[ReplayWorker] = None
         spill_path = resolve_spill_dir(
@@ -214,6 +229,49 @@ class EventServer:
         with idempotency_key(record["token"]):
             self._breaker.call(events.insert_batch, evs, record["appId"],
                                record.get("channelId"))
+        # Replayed events are now servable — advance the watermark they
+        # could not advance while journaled.
+        self._note_ingest(record["appId"], evs)
+
+    def _note_ingest(self, app_id: int, evs) -> None:
+        """Advance the per-app ingest high-watermark gauge after events
+        LANDED in the store.  First touch of an app seeds the floor from
+        the backend's own MAX so a restarted server reports the true
+        store-wide watermark, not just this process's ingest."""
+        from predictionio_tpu.data.storage.base import epoch_us
+
+        newest = None
+        for ev in evs:
+            us = epoch_us(ev.event_time)
+            if us is not None and (newest is None or us > newest):
+                newest = us
+        if newest is None:
+            return
+        with self._latest_lock:
+            cur = self._latest_ts.get(app_id)
+            if cur is None:
+                # The gauge is APP-level; the store's MAX is per channel,
+                # so the seed must cover the default channel AND every
+                # named channel — else a restart under channel traffic
+                # would republish a regressed watermark.
+                try:
+                    events = self.storage.get_events()
+                    maxes = [epoch_us(events.latest_event_time(app_id))]
+                    for ch in self.storage.get_channels() \
+                            .get_by_app_id(app_id):
+                        maxes.append(epoch_us(
+                            events.latest_event_time(app_id, ch.id)))
+                    known = [m for m in maxes if m is not None]
+                    cur = max(known) if known else newest
+                except Exception:
+                    # Seeding is best-effort; the in-process max is still
+                    # a valid (conservative) watermark.
+                    cur = newest
+            val = max(cur, newest)
+            self._latest_ts[app_id] = val
+            # set under the lock: two concurrent ingests must publish in
+            # watermark order, never let a smaller max land last
+            self.stats.latest_ts.set(val / 1e6, app=str(app_id))
 
     # -- request-handling core (transport-independent, used by tests) ------
 
@@ -299,6 +357,7 @@ class EventServer:
             with idempotency_key(token):
                 event_id = self._breaker.call(
                     events.insert, ev, key_row.app_id, channel_id)
+            self._note_ingest(key_row.app_id, [ev])
             return 201, {"eventId": event_id}
         except _UNAVAILABLE:
             spilled = self._spill_events([event_to_json(ev)],
@@ -587,6 +646,7 @@ class EventServer:
                         key_row.app_id, channel_id)
                 for (i, ev), eid in zip(valid, ids):
                     outs[i] = (201, {"eventId": eid}, ev.event)
+                self._note_ingest(key_row.app_id, [ev for _, ev in valid])
             except _UNAVAILABLE as e:
                 # Mid-batch storage outage: EVERY valid item gets an
                 # explicit answer — spilled (202 + the batch's token)

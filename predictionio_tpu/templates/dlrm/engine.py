@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import zlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,6 +32,7 @@ from predictionio_tpu.controller import (
     FirstServing,
     IdentityPreparator,
     RuntimeContext,
+    WarmStartFallback,
 )
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.models import dlrm as dlrm_lib
@@ -163,6 +165,10 @@ class DLRMModelWrapper:
     user_vocab: int
     item_vocab: int
     n_dense: int
+    # Warm-start carry (ISSUE 10): the wrapper already holds the full
+    # train state, so continuation only needs the corpus size for the
+    # delta-fraction gate.  0 on wrappers from older generations.
+    n_examples: int = 0
 
 
 class DLRMAlgorithm(Algorithm):
@@ -185,7 +191,87 @@ class DLRMAlgorithm(Algorithm):
                                prepared_data.labels, cfg, mesh=ctx.mesh)
         return DLRMModelWrapper(state=state, cfg=cfg, user_vocab=p.userVocab,
                                 item_vocab=p.itemVocab,
-                                n_dense=prepared_data.n_dense)
+                                n_dense=prepared_data.n_dense,
+                                n_examples=len(prepared_data.labels))
+
+    @staticmethod
+    def _sample_logloss(model_state, cfg, dense, cat, labels) -> float:
+        proba = np.asarray(dlrm_lib.predict_proba(model_state, dense, cat,
+                                                  cfg), np.float64)
+        p = np.clip(proba, 1e-7, 1.0 - 1e-7)
+        y = np.asarray(labels, np.float64)
+        return float(-np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+
+    def warm_start(self, ctx: RuntimeContext, prepared_delta: CTRData,
+                   prev_model: DLRMModelWrapper, warm: Any) -> DLRMModelWrapper:
+        """Delta warm-start (ISSUE 10): DLRM's hashed vocabularies are
+        fixed-size, so continuation is just more optimizer steps on the
+        delta window from the carried state — unseen entities already
+        land in shared hash buckets.  Gates mirror the two-tower
+        template: config compatibility, delta fraction, and a log-loss
+        regression check on a fixed delta sample."""
+        log = logging.getLogger(__name__)
+        p: DLRMAlgorithmParams = self.params
+        prev_n = int(getattr(prev_model, "n_examples", 0))
+        delta_n = len(prepared_delta.labels)
+        cfg = prev_model.cfg
+        seed_now = p.seed if p.seed is not None else ctx.seed
+        if (cfg.vocab_sizes != (p.userVocab, p.itemVocab)
+                or cfg.n_dense != prepared_delta.n_dense
+                or cfg.embed_dim != p.embedDim
+                or cfg.bottom_mlp != tuple(p.bottomMlp)
+                or cfg.top_mlp != tuple(p.topMlp)
+                or cfg.learning_rate != p.learningRate
+                or cfg.batch_size != p.batchSize
+                or cfg.seed != seed_now):
+            raise WarmStartFallback("algorithm config changed")
+        max_frac = getattr(warm, "max_delta_fraction", 0.5)
+        if prev_n <= 0 or delta_n > max_frac * prev_n:
+            raise WarmStartFallback(
+                f"delta window too large for continuation ({delta_n} "
+                f"events vs {prev_n} trained; max fraction {max_frac:g})")
+        if delta_n == 0:
+            return DLRMModelWrapper(state=prev_model.state, cfg=cfg,
+                                    user_vocab=p.userVocab,
+                                    item_vocab=p.itemVocab,
+                                    n_dense=prepared_delta.n_dense,
+                                    n_examples=prev_n)
+        cfg = dataclasses.replace(cfg, epochs=p.epochs)
+        rng = np.random.default_rng(cfg.seed)
+        sample = rng.choice(delta_n, size=min(delta_n, 1024), replace=False)
+        sd, sc, sy = (prepared_delta.dense[sample],
+                      prepared_delta.cat[sample],
+                      prepared_delta.labels[sample])
+        loss_before = self._sample_logloss(prev_model.state, cfg, sd, sc, sy)
+        # Fresh buffers for the continuation: the train loop DONATES its
+        # carried state, and prev_model keeps serving (and is the
+        # comparison baseline above) — it must never hand over its own
+        # arrays on donation-capable backends.
+        import jax
+        import jax.numpy as jnp
+
+        carried = dlrm_lib.DLRMState(
+            params=jax.tree.map(lambda x: jnp.array(x, copy=True),
+                                prev_model.state.params),
+            opt_state=jax.tree.map(lambda x: jnp.array(x, copy=True),
+                                   prev_model.state.opt_state),
+            step=jnp.array(prev_model.state.step, copy=True))
+        state = dlrm_lib.train(prepared_delta.dense, prepared_delta.cat,
+                               prepared_delta.labels, cfg, mesh=ctx.mesh,
+                               warm_state=carried)
+        loss_after = self._sample_logloss(state, cfg, sd, sc, sy)
+        tol = getattr(warm, "eval_tolerance", 0.1)
+        if not np.isfinite(loss_after) \
+                or loss_after > loss_before * (1.0 + tol) + 1e-9:
+            raise WarmStartFallback(
+                f"warm-started eval regressed on the delta sample "
+                f"({loss_before:.4f} → {loss_after:.4f}, tolerance {tol:g})")
+        log.info("dlrm warm-start: +%d events, delta-sample logloss "
+                 "%.4f → %.4f", delta_n, loss_before, loss_after)
+        return DLRMModelWrapper(state=state, cfg=cfg, user_vocab=p.userVocab,
+                                item_vocab=p.itemVocab,
+                                n_dense=prepared_delta.n_dense,
+                                n_examples=prev_n + delta_n)
 
     def predict(self, model: DLRMModelWrapper, query: Query) -> PredictedResult:
         if not query.items:

@@ -17,8 +17,11 @@ equations) instead of Spark MLlib; serving top-K is one MXU matmul +
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import logging
 import os
+import threading
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -95,6 +98,13 @@ class Ratings:
     ratings: np.ndarray
     user_index: BiMap
     item_index: BiMap
+    # Serving fold-in context (ISSUE 10): the trained wrapper needs to
+    # know WHERE its events live and how to weigh them so an unseen
+    # user's recent events can be solved in at predict time.  Filled by
+    # the datasource; defaults keep older pickles/tests loading.
+    app_name: Optional[str] = None
+    event_names: Sequence[str] = ()
+    buy_rating: float = 4.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -160,6 +170,9 @@ class RecommendationDataSource(DataSource):
             ratings=ratings,
             user_index=user_index,
             item_index=item_index,
+            app_name=p.appName,
+            event_names=tuple(p.eventNames),
+            buy_rating=p.buyRating,
         )
 
     def read_training(self, ctx: RuntimeContext) -> Ratings:
@@ -229,6 +242,36 @@ class ALSAlgorithmParams(Params):
     gatherWindow: Union[bool, str] = "auto"  # noqa: N815
 
 
+def _fold_in_enabled() -> bool:
+    from predictionio_tpu.config import env_bool
+
+    return env_bool(os.environ.get("PIO_FOLD_IN"), True)
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        return int(os.environ.get(key, str(default)) or default)
+    except ValueError:
+        return default
+
+
+def _fold_metric():
+    from predictionio_tpu.obs import get_registry
+
+    return get_registry().counter(
+        "pio_fold_in_total",
+        "Serve-time ALS fold-in attempts by outcome "
+        "(cached/solved/no_events/unavailable).", ("result",))
+
+
+# Negative fold-in cache TTL: a user with NO mappable events is cached
+# too (an unknown-user query storm must not pay one event-store read —
+# a remote RPC on pioserver storage — per request inside the cohort
+# dispatch), but only briefly: their first events should become
+# recommendations within seconds, not a generation lifetime.
+_FOLD_NEG_TTL_S = 30.0
+
+
 # eq=False: wrapper identity IS the model generation — keeps the object
 # hashable for the weak-keyed retriever cache.
 @dataclasses.dataclass(eq=False)
@@ -240,18 +283,45 @@ class ALSModelWrapper:
     moves model and index as one artifact: a rollback can never serve
     generation-N factors through a generation-N+1 index (the retrieval
     facade's fingerprint check makes any future violation loud).
+
+    Serve-time fold-in (ISSUE 10): an UNSEEN user with recent events
+    gets one ridge solve against the frozen item factors
+    (``models.als.fold_in``) instead of a cold-start empty result.  The
+    folded factor lives in a bounded per-generation LRU — per-process
+    and ephemeral by design; the next refresh trains the user in and
+    makes it durable.
     """
 
     model: als_lib.ALSModel
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Fold-in context (ISSUE 10), persisted with the generation.
+    app_name: Optional[str] = None
+    fold_event_names: Sequence[str] = ()
+    buy_rating: float = 4.0
+    reg: float = 0.01
+    alpha: float = 1.0
     # Host-resident factor copies for the serving fast path: a B=1
     # predict is ~N·K MACs — orders of magnitude below one device
     # dispatch round-trip — so small batches are answered in numpy from
     # these (pulled once, lazily).  None until first host predict.
     _host: Optional[Tuple[np.ndarray, np.ndarray]] = None
     _host_uf: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        self._init_transients()
+
+    def _init_transients(self) -> None:
+        # Per-generation serving state — never pickled, dies with the
+        # wrapper on reload/rollback (exactly the bounded-cache contract).
+        # Values are (vector | None, monotonic-stamp): None is a TTL'd
+        # negative entry (user had no usable events at stamp time).
+        self._fold_cache: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self._fold_lock = threading.Lock()
+        self._event_store = None
+        self._yty: Optional[np.ndarray] = None
 
     def __getstate__(self):
         # serving caches are transient (a reloaded model rebuilds them;
@@ -260,7 +330,18 @@ class ALSModelWrapper:
         d = self.__dict__.copy()
         d["_host"] = None
         d["_host_uf"] = None
+        for k in ("_fold_cache", "_fold_lock", "_event_store", "_yty"):
+            d.pop(k, None)
         return d
+
+    def __setstate__(self, d):
+        # Backfill fields a pre-ISSUE-10 pickle lacks, then rebuild the
+        # transient serving state.
+        for f in dataclasses.fields(self):
+            if f.name not in d and f.default is not dataclasses.MISSING:
+                d[f.name] = f.default
+        self.__dict__.update(d)
+        self._init_transients()
 
     def retriever(self) -> Retriever:
         """THE serving route to the item corpus (retrieval facade):
@@ -299,6 +380,91 @@ class ALSModelWrapper:
                           itf[:len(self.item_index)])
         return self._host
 
+    # -- serve-time fold-in (ISSUE 10) ---------------------------------
+
+    def fold_in_user(self, user: str) -> Optional[np.ndarray]:
+        """Solve an unseen user's factor from their recent events against
+        the frozen item factors; None when fold-in is off, no event
+        store is attached (non-serving contexts like eval), or the user
+        has no mappable events.  Cached per generation (bounded LRU) so
+        repeat visitors never re-solve — the cache dies with the
+        wrapper on reload/rollback, exactly when the factors it was
+        solved against do."""
+        import time as _time
+
+        es = getattr(self, "_event_store", None)
+        app = getattr(self, "app_name", None)
+        if es is None or not app or not _fold_in_enabled():
+            return None
+        with self._fold_lock:
+            hit = self._fold_cache.get(user)
+            if hit is not None:
+                vec, t = hit
+                if vec is not None or \
+                        _time.monotonic() - t < _FOLD_NEG_TTL_S:
+                    self._fold_cache.move_to_end(user)
+                    _fold_metric().inc(result="cached")
+                    return vec
+                del self._fold_cache[user]  # expired negative: re-check
+        from predictionio_tpu.obs import span
+
+        try:
+            with span("fold_in", user=user):
+                events = es.find_by_entity(
+                    app, "user", user,
+                    event_names=list(self.fold_event_names) or None,
+                    target_entity_type="item",
+                    limit=_env_int("PIO_FOLD_IN_EVENTS", 50), latest=True)
+        except Exception:
+            # A storage blip must degrade to a cold-start answer, never
+            # fail the cohort this member rides in.
+            logging.getLogger(__name__).debug("fold-in event read failed",
+                                              exc_info=True)
+            _fold_metric().inc(result="unavailable")
+            return None
+        ids: List[int] = []
+        vals: List[float] = []
+        for ev in events:
+            idx = self.item_index.get(ev.target_entity_id)
+            if idx is None:
+                continue  # item unknown to this generation
+            if ev.event == "rate":
+                r = ev.properties.get("rating")
+                if not isinstance(r, (int, float)) or not np.isfinite(r):
+                    continue  # same drop rule as the training read
+                vals.append(float(r))
+            else:
+                vals.append(float(self.buy_rating))
+            ids.append(int(idx))
+        if not ids:
+            self._fold_store(user, None)
+            _fold_metric().inc(result="no_events")
+            return None
+        _, itf = self.host_factors()
+        if self.model.implicit and self._yty is None:
+            f = itf.astype(np.float64)
+            self._yty = f.T @ f
+        vec = als_lib.fold_in(
+            itf, np.asarray(ids), np.asarray(vals, np.float32),
+            reg=float(getattr(self, "reg", 0.01)),
+            alpha=float(getattr(self, "alpha", 1.0)),
+            implicit=self.model.implicit, yty=self._yty)
+        self._fold_store(user, vec)
+        _fold_metric().inc(result="solved")
+        return vec
+
+    def _fold_store(self, user: str, vec: Optional[np.ndarray]) -> None:
+        """Bounded-LRU insert; ``vec=None`` is the (TTL'd) negative
+        entry for a user with no usable events."""
+        import time as _time
+
+        with self._fold_lock:
+            self._fold_cache[user] = (vec, _time.monotonic())
+            self._fold_cache.move_to_end(user)
+            cap = _env_int("PIO_FOLD_IN_CACHE", 10000)
+            while len(self._fold_cache) > max(cap, 1):
+                self._fold_cache.popitem(last=False)
+
     def post_load(self, ctx) -> None:
         """Serving-time re-parallelization (reference: SURVEY §3.2, P
         models re-parallelize in CreateServer): with a serving mesh and
@@ -307,7 +473,16 @@ class ALSModelWrapper:
         facade's :meth:`~predictionio_tpu.retrieval.Retriever.maybe_shard`
         pads host-side and stages shard-by-shard, and predict then
         routes through the mesh-sharded exact rung (per-chip memory and
-        score work scale 1/n_chips)."""
+        score work scale 1/n_chips).
+
+        Also the fold-in attachment point (ISSUE 10): ``post_load`` is
+        the one hook that sees the serving RuntimeContext, so the
+        wrapper stashes the event store here — transient, never
+        pickled — and ``batch_predict`` can then solve unseen users in
+        from their recent events."""
+        store = getattr(ctx, "event_store", None)
+        if store is not None:
+            self._event_store = store
         mesh = getattr(ctx, "mesh", None)
         if mesh is None:
             return
@@ -377,6 +552,16 @@ class ALSAlgorithm(Algorithm):
             # explicit PIO_IVF=on, never auto.
             ivf=build_train_index(itf_host, name="als", seed=cfg.seed,
                                   require_explicit=True),
+            # Fold-in context (ISSUE 10): where this generation's events
+            # live + the solve hyper-parameters it was trained with, so
+            # serve-time fold-in solves the SAME normal equation the
+            # training sweep would.
+            app_name=getattr(prepared_data, "app_name", None),
+            fold_event_names=tuple(
+                getattr(prepared_data, "event_names", ()) or ()),
+            buy_rating=float(getattr(prepared_data, "buy_rating", 4.0)),
+            reg=float(p.lambda_),
+            alpha=float(p.alpha),
         )
 
     def predict(self, model: ALSModelWrapper, query: Query) -> PredictedResult:
@@ -393,17 +578,44 @@ class ALSAlgorithm(Algorithm):
         mesh-sharded / chunked device scoring, the train-time IVF index,
         pow2 batch + K-menu compile discipline) lives in
         :mod:`predictionio_tpu.retrieval` — this template only maps ids.
+
+        Unseen users try serve-time fold-in first (ISSUE 10,
+        :meth:`ALSModelWrapper.fold_in_user`): a repeat visitor's cached
+        (or freshly solved) factor rides the SAME cohort retrieval as
+        trained users, so fold-in costs one extra query row, not a
+        second dispatch.  Users with no usable events still answer the
+        cold-start empty result.
         """
         known = [(i, q) for i, q in queries if q.user in model.user_index]
-        out = [(i, PredictedResult(itemScores=[])) for i, q in queries
-               if q.user not in model.user_index]
-        if known:
-            num = max(q.num for _, q in known)
-            idxs = np.asarray([model.user_index[q.user] for _, q in known])
+        rows: List[np.ndarray] = []
+        cold: List[Tuple[int, "Query"]] = []
+        folded: List[Tuple[int, "Query"]] = []
+        for i, q in queries:
+            if q.user in model.user_index:
+                continue
+            vec = model.fold_in_user(q.user)
+            if vec is None:
+                cold.append((i, q))
+            else:
+                folded.append((i, q))
+                rows.append(vec)
+        out = [(i, PredictedResult(itemScores=[])) for i, q in cold]
+        answerable = known + folded
+        if answerable:
+            num = max(q.num for _, q in answerable)
             uf = model.host_user_factors()
-            scores, ids, _info = model.retriever().topk(uf[idxs], num)
+            qmat_parts = []
+            if known:
+                idxs = np.asarray([model.user_index[q.user]
+                                   for _, q in known])
+                qmat_parts.append(uf[idxs])
+            if rows:
+                qmat_parts.append(np.stack(rows))
+            qmat = np.concatenate(qmat_parts, axis=0) \
+                if len(qmat_parts) > 1 else qmat_parts[0]
+            scores, ids, _info = model.retriever().topk(qmat, num)
             inv = model.item_index.inverse
-            for row, (i, q) in enumerate(known):
+            for row, (i, q) in enumerate(answerable):
                 out.append((i, PredictedResult(itemScores=[
                     ItemScore(item=inv[ii], score=ss)
                     for ii, ss in iter_hits(scores[row], ids[row], q.num)

@@ -15,7 +15,8 @@ DP over the ``data`` mesh axis, MIPS top-K serve.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+import logging
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -27,6 +28,7 @@ from predictionio_tpu.controller import (
     FirstServing,
     IdentityPreparator,
     RuntimeContext,
+    WarmStartFallback,
 )
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
@@ -128,6 +130,14 @@ class TwoTowerModelWrapper:
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Warm-start carry (ISSUE 10): the host-numpy train state + the
+    # config it was trained under + the interaction count — what the
+    # next refresh needs to CONTINUE training on a delta window instead
+    # of retraining from scratch.  None on wrappers from older
+    # generations (warm_start then falls back to a full retrain).
+    train_state: Optional[Dict] = None
+    train_cfg: Optional[tt_lib.TwoTowerConfig] = None
+    n_examples: int = 0
 
     def retriever(self) -> Retriever:
         """THE serving route to the item corpus (retrieval facade):
@@ -150,16 +160,35 @@ class TwoTowerModelWrapper:
             self.retriever().maybe_shard(mesh)
 
 
+def _merge_index(prev: BiMap, delta: BiMap) -> BiMap:
+    """Extend ``prev`` with delta-only keys appended AFTER the existing
+    range (existing entities keep their embedding rows; new ones map to
+    the grown tail).  Delta keys append in their first-seen order, so
+    the merge is deterministic."""
+    m = dict(prev.items())
+    for k in delta:
+        if k not in m:
+            m[k] = len(m)
+    return BiMap(m)
+
+
+def _remap_codes(codes: np.ndarray, delta_index: BiMap,
+                 merged: BiMap) -> np.ndarray:
+    """Delta-local int codes → merged global ids (one vectorized take)."""
+    lookup = np.asarray([merged[k] for k in delta_index.to_numpy_keys()],
+                        np.int64)
+    return lookup[np.asarray(codes, np.int64)]
+
+
 class TwoTowerAlgorithm(Algorithm):
     params_class = TwoTowerAlgorithmParams
 
-    def train(self, ctx: RuntimeContext, prepared_data: InteractionData) -> TwoTowerModelWrapper:
+    def _config(self, ctx: RuntimeContext, n_users: int,
+                n_items: int) -> tt_lib.TwoTowerConfig:
         p: TwoTowerAlgorithmParams = self.params
-        if len(prepared_data.user_ids) == 0:
-            raise ValueError("No interaction events found — check appName.")
-        cfg = tt_lib.TwoTowerConfig(
-            n_users=len(prepared_data.user_index),
-            n_items=len(prepared_data.item_index),
+        return tt_lib.TwoTowerConfig(
+            n_users=n_users,
+            n_items=n_items,
             embed_dim=p.embedDim,
             hidden_dims=tuple(p.hiddenDims),
             out_dim=p.outDim,
@@ -169,22 +198,122 @@ class TwoTowerAlgorithm(Algorithm):
             epochs=p.epochs,
             seed=p.seed if p.seed is not None else ctx.seed,
         )
-        state = tt_lib.train(prepared_data.user_ids, prepared_data.item_ids,
-                             cfg, mesh=ctx.mesh)
+
+    def _wrap(self, state: "tt_lib.TwoTowerState",
+              cfg: tt_lib.TwoTowerConfig, user_index: BiMap,
+              item_index: BiMap, n_examples: int) -> TwoTowerModelWrapper:
         user_vecs = np.asarray(
             tt_lib.encode_users(state.params, jnp.arange(cfg.n_users)))
         item_vecs = np.asarray(
             tt_lib.encode_items(state.params, jnp.arange(cfg.n_items)))
         return TwoTowerModelWrapper(
             user_vecs=user_vecs, item_vecs=item_vecs,
-            user_index=prepared_data.user_index,
-            item_index=prepared_data.item_index,
+            user_index=user_index,
+            item_index=item_index,
             # Train-time coarse index (policy-gated: PIO_IVF /
             # PIO_IVF_MIN_ITEMS) — the normalized tower outputs are the
             # IVF design target; serialized with the model so the
             # generation swap moves both atomically.
             ivf=build_train_index(item_vecs, name="twotower",
-                                  seed=cfg.seed))
+                                  seed=cfg.seed),
+            train_state=tt_lib.state_to_host(state),
+            train_cfg=cfg,
+            n_examples=int(n_examples))
+
+    def train(self, ctx: RuntimeContext, prepared_data: InteractionData) -> TwoTowerModelWrapper:
+        if len(prepared_data.user_ids) == 0:
+            raise ValueError("No interaction events found — check appName.")
+        cfg = self._config(ctx, len(prepared_data.user_index),
+                           len(prepared_data.item_index))
+        state = tt_lib.train(prepared_data.user_ids, prepared_data.item_ids,
+                             cfg, mesh=ctx.mesh)
+        return self._wrap(state, cfg, prepared_data.user_index,
+                          prepared_data.item_index,
+                          len(prepared_data.user_ids))
+
+    def warm_start(self, ctx: RuntimeContext, prepared_delta: InteractionData,
+                   prev_model: TwoTowerModelWrapper,
+                   warm: Any) -> TwoTowerModelWrapper:
+        """Delta warm-start (ISSUE 10 tentpole): restore the previous
+        generation's carried train state, grow the embedding tables for
+        entities first seen in the delta window, and CONTINUE training
+        on the delta only — riding the same
+        ``DevicePrefetcher``/fused-dispatch/supervision loop a full
+        train uses.
+
+        Falls back (``WarmStartFallback`` → full retrain in the same
+        engine instance) when: the previous wrapper carries no train
+        state (older generation), the algorithm config changed (shapes
+        or optimizer semantics differ), the delta exceeds
+        ``warm.max_delta_fraction`` of the previous corpus, or the
+        continued model's loss on a fixed delta sample REGRESSES past
+        ``warm.eval_tolerance`` vs the state it started from (a
+        divergent continuation must never be promoted on the cheap
+        path)."""
+        log = logging.getLogger(__name__)
+        snapshot = getattr(prev_model, "train_state", None)
+        prev_cfg = getattr(prev_model, "train_cfg", None)
+        if snapshot is None or prev_cfg is None:
+            raise WarmStartFallback(
+                "previous generation carries no train state")
+        delta_n = len(prepared_delta.user_ids)
+        prev_n = int(getattr(prev_model, "n_examples", 0))
+        cfg_now = self._config(ctx, prev_cfg.n_users, prev_cfg.n_items)
+        for f in ("embed_dim", "hidden_dims", "out_dim", "learning_rate",
+                  "temperature", "batch_size", "seed"):
+            if getattr(cfg_now, f) != getattr(prev_cfg, f):
+                raise WarmStartFallback(
+                    f"algorithm config changed ({f}: "
+                    f"{getattr(prev_cfg, f)!r} → {getattr(cfg_now, f)!r})")
+        max_frac = getattr(warm, "max_delta_fraction", 0.5)
+        if prev_n <= 0 or delta_n > max_frac * prev_n:
+            raise WarmStartFallback(
+                f"delta window too large for continuation "
+                f"({delta_n} events vs {prev_n} trained; "
+                f"max fraction {max_frac:g})")
+        # Merge the delta's entities into the previous index: existing
+        # rows keep their ids (and factors); new entities append.
+        user_index = _merge_index(prev_model.user_index,
+                                  prepared_delta.user_index)
+        item_index = _merge_index(prev_model.item_index,
+                                  prepared_delta.item_index)
+        uids = _remap_codes(prepared_delta.user_ids,
+                            prepared_delta.user_index, user_index)
+        iids = _remap_codes(prepared_delta.item_ids,
+                            prepared_delta.item_index, item_index)
+        cfg = dataclasses.replace(prev_cfg, n_users=len(user_index),
+                                  n_items=len(item_index),
+                                  epochs=self.params.epochs)
+        state = tt_lib.grow_state(tt_lib.state_from_host(snapshot), cfg)
+        if delta_n == 0:
+            # Nothing new: re-land the carried state as a fresh
+            # generation (its watermark still advances — staleness is
+            # measured against the WINDOW, not the weights).
+            return self._wrap(state, cfg, user_index, item_index, prev_n)
+        # Regression gate sample: fixed (seeded) subset of the delta,
+        # scored before and after continuation at the same temperature.
+        rng = np.random.default_rng(cfg.seed)
+        sample = rng.choice(delta_n, size=min(delta_n, 1024), replace=False)
+        loss_before = tt_lib.eval_loss(state.params, uids[sample],
+                                       iids[sample], cfg)
+        trained = tt_lib.train(uids, iids, cfg, mesh=ctx.mesh,
+                               warm_state=state)
+        loss_after = tt_lib.eval_loss(trained.params, uids[sample],
+                                      iids[sample], cfg)
+        tol = getattr(warm, "eval_tolerance", 0.1)
+        if not np.isfinite(loss_after) \
+                or loss_after > loss_before * (1.0 + tol) + 1e-9:
+            raise WarmStartFallback(
+                f"warm-started eval regressed on the delta sample "
+                f"({loss_before:.4f} → {loss_after:.4f}, "
+                f"tolerance {tol:g})")
+        log.info("two_tower warm-start: +%d events (%d new users, %d new "
+                 "items), delta-sample loss %.4f → %.4f",
+                 delta_n, len(user_index) - len(prev_model.user_index),
+                 len(item_index) - len(prev_model.item_index),
+                 loss_before, loss_after)
+        return self._wrap(trained, cfg, user_index, item_index,
+                          prev_n + delta_n)
 
     def predict(self, model: TwoTowerModelWrapper, query: Query) -> PredictedResult:
         # A batch of one: the facade's host fast path answers a lone

@@ -17,6 +17,7 @@ blob store keyed by engine-instance id.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime as _dt
 import json
 import logging
@@ -33,6 +34,7 @@ from predictionio_tpu.controller import (
     MetricEvaluatorResult,
     PersistentModel,
     RuntimeContext,
+    WarmStartFallback,
 )
 from predictionio_tpu.controller.params import params_to_dict
 from predictionio_tpu.data.storage import (
@@ -44,6 +46,7 @@ from predictionio_tpu.data.storage import (
 from predictionio_tpu.obs import (
     get_memory_sampler,
     phase as obs_phase,
+    publish_event,
     trace as obs_trace,
 )
 from predictionio_tpu.resilience.supervision import TrainPreempted
@@ -51,7 +54,28 @@ from predictionio_tpu.version import __version__
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["WorkflowError", "run_train", "load_models", "run_evaluation"]
+__all__ = ["WorkflowError", "run_train", "load_models", "run_evaluation",
+           "data_watermark", "DATA_WATERMARK_KEY"]
+
+# EngineInstance.env keys of the online-refresh loop (ISSUE 10).  The env
+# dict rides every backend's existing row format (JSON column / deepcopy /
+# RPC), so the watermark needs no storage schema change.
+DATA_WATERMARK_KEY = "dataWatermark"   # ISO-8601 until-bound of the data read
+REFRESH_MODE_KEY = "refreshMode"       # "full" | "warm"
+WARM_FROM_KEY = "warmStartFrom"        # parent COMPLETED instance id
+
+
+def data_watermark(instance: EngineInstance) -> Optional[_dt.datetime]:
+    """The data high-watermark recorded on a train run: every event with
+    ``event_time < watermark`` was visible to (and bounded the read of)
+    that generation.  None for instances written before ISSUE 10."""
+    raw = (instance.env or {}).get(DATA_WATERMARK_KEY)
+    if not raw:
+        return None
+    try:
+        return _dt.datetime.fromisoformat(raw)
+    except ValueError:
+        return None
 
 
 class WorkflowError(RuntimeError):
@@ -78,16 +102,40 @@ def run_train(
     *,
     engine_id: Optional[str] = None,
     engine_version: str = __version__,
+    warm_from: Any = None,
 ) -> str:
     """Train an engine variant; returns the COMPLETED engine-instance id.
 
     Reference: CoreWorkflow.runTrain — including the FAILED-status write on
     error (§5.3 failure observation) which the caller relies on.
+
+    Every run stamps a **data watermark** BEFORE the datasource reads and
+    scopes the read to ``event_time < watermark`` (via
+    :class:`~predictionio_tpu.data.store.WindowedEventStore`), recording
+    the bound in ``instance.env[dataWatermark]`` — this is what makes
+    consecutive refresh windows gap- and overlap-free (ISSUE 10): events
+    landing mid-read belong to the NEXT generation, by construction.
+
+    ``warm_from`` (a :class:`~predictionio_tpu.refresh.WarmStartContext`)
+    switches the run to delta warm-start mode: the datasource reads only
+    ``[previous watermark, new watermark)`` and each algorithm continues
+    the previous generation's model.  Any
+    :class:`~predictionio_tpu.controller.WarmStartFallback` (unsupported
+    algorithm, oversized delta, regressed continuation) falls back to a
+    full retrain over the complete window inside the SAME engine
+    instance — a refresh cycle always lands one generation.
     """
     ctx = ctx or RuntimeContext.create()
     storage: Storage = ctx.storage
     engine_params = engine.bind_engine_params(variant.raw)
     ep_json = _engine_params_json(engine_params)
+    # The watermark is pinned before ANY event is read; naive-free UTC ISO
+    # so every backend and every host parses the same instant back.
+    watermark = _now()
+    env = {DATA_WATERMARK_KEY: watermark.isoformat(),
+           REFRESH_MODE_KEY: "warm" if warm_from is not None else "full"}
+    if warm_from is not None and getattr(warm_from, "instance", None):
+        env[WARM_FROM_KEY] = warm_from.instance.id
     instance = EngineInstance(
         id=None,
         status="TRAINING",
@@ -97,6 +145,7 @@ def run_train(
         engine_version=engine_version,
         engine_variant=variant.variant_id,
         engine_factory=variant.engine_factory,
+        env=env,
         datasource_params=json.dumps(ep_json["datasource"]["params"]),
         preparator_params=json.dumps(ep_json["preparator"]["params"]),
         algorithms_params=json.dumps(ep_json["algorithms"]),
@@ -112,15 +161,44 @@ def run_train(
     sampler = get_memory_sampler()
     sampler.reset_peak()
     sampler.start()
+
+    def _windowed(start: Optional[_dt.datetime]) -> RuntimeContext:
+        from predictionio_tpu.data.store import WindowedEventStore
+
+        return dataclasses.replace(
+            ctx, event_store=WindowedEventStore(storage, start, watermark))
+
     try:
         # One trace per training run: the DASE phases inside Engine.train
         # (datasource/prepare/algorithm) plus the persist phase below hang
         # off this root; recorded to the ring / PIO_TRACE_FILE on exit.
         with obs_trace("workflow.train",
                        engine_factory=variant.engine_factory,
-                       instance=instance_id):
-            models = _maybe_profiled(
-                ctx, lambda: engine.train(ctx, engine_params))
+                       instance=instance_id,
+                       mode=env[REFRESH_MODE_KEY]):
+            models = None
+            if warm_from is not None:
+                try:
+                    wctx = _windowed(warm_from.start_time)
+                    models = _maybe_profiled(
+                        ctx, lambda: engine.train(wctx, engine_params,
+                                                  warm=warm_from))
+                except WarmStartFallback as e:
+                    # The fallback is part of the contract, not a failure:
+                    # retrain fully over the complete window, same
+                    # instance, and record which road was taken.
+                    logger.warning(
+                        "EngineInstance %s: warm-start declined (%s) — "
+                        "falling back to a full retrain", instance_id,
+                        e.reason)
+                    publish_event("refresh.warm_fallback",
+                                  instance=instance_id,
+                                  reason=e.reason[:200])
+                    instance.env[REFRESH_MODE_KEY] = "full_fallback"
+            if models is None:
+                fctx = _windowed(None)
+                models = _maybe_profiled(
+                    ctx, lambda: engine.train(fctx, engine_params))
             with obs_phase("train.persist"):
                 _persist_models(models, instance_id, ctx)
             sampler.sample_once()

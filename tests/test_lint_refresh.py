@@ -1,0 +1,106 @@
+"""tools/lint_refresh.py: refresh promotes through the staged-reload gate.
+
+ISSUE 10 satellite — a continuously-retraining daemon must never grow a
+shortcut around the PR-4 promotion machinery: direct model-store writes
+and out-of-server generation swaps fail tier-1.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_refresh  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_refresh.check(REPO) == []
+
+
+def test_detects_direct_model_store_write():
+    src = """
+def sneak(storage, blob):
+    storage.get_models().insert(blob)
+"""
+    violations = lint_refresh.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_refresh=False)
+    assert len(violations) == 1
+    assert "staged-reload gate" in violations[0]
+
+
+def test_detects_split_chain_model_store_write():
+    src = """
+def sneak(storage, blob):
+    repo = storage.get_models()
+    repo.insert(blob)
+"""
+    violations = lint_refresh.check_source(
+        src, "t.py", ("cli", "main.py"), in_refresh=False)
+    assert len(violations) == 1
+
+
+def test_sanctioned_writers_pass():
+    src = "def persist(storage, m):\n    storage.get_models().insert(m)\n"
+    assert lint_refresh.check_source(
+        src, "core_workflow.py", ("workflow", "core_workflow.py"),
+        in_refresh=False) == []
+    # storage backends implement the repository itself
+    assert lint_refresh.check_source(
+        src, "memory.py", ("storage", "memory.py"), in_refresh=False) == []
+
+
+def test_detects_generation_swap_outside_server():
+    src = """
+def hot_swap(srv, models):
+    srv._models = models
+    srv._generation += 1
+"""
+    violations = lint_refresh.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_refresh=False)
+    assert len(violations) == 2
+    assert all("engine_server" in v for v in violations)
+
+
+def test_self_generation_state_is_fine_anywhere():
+    # a class managing ITS OWN fields of the same name is not a swap of
+    # the engine server's state
+    src = """
+class Thing:
+    def __init__(self):
+        self._models = []
+        self._generation = 0
+"""
+    assert lint_refresh.check_source(
+        src, "t.py", ("serving", "queue.py"), in_refresh=False) == []
+
+
+def test_engine_server_itself_passes():
+    src = "def swap(srv, m):\n    srv._models = m\n"
+    assert lint_refresh.check_source(
+        src, "engine_server.py", ("server", "engine_server.py"),
+        in_refresh=False) == []
+
+
+def test_refresh_package_forbidden_names():
+    src = """
+from predictionio_tpu.resilience.supervision import validate_model_finite
+
+def diy_gate(storage, models):
+    validate_model_finite(models)
+    storage.get_models()
+"""
+    violations = lint_refresh.check_source(
+        src, "daemon.py", ("refresh", "daemon.py"), in_refresh=True)
+    names = "\n".join(violations)
+    assert "validate_model_finite" in names
+    assert "get_models" in names
+
+
+def test_cli_exit_codes(tmp_path):
+    assert lint_refresh.main([str(REPO)]) == 0
+    pkg = tmp_path / "predictionio_tpu" / "refresh"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        "def f(storage, m):\n    storage.get_models().insert(m)\n")
+    assert lint_refresh.main([str(tmp_path)]) == 1

@@ -158,6 +158,88 @@ class TestEventsContract:
         assert [e.event_time for e in newest] == [ts("2026-01-04T00:00:00"),
                                                   ts("2026-01-03T00:00:00")]
 
+    def test_time_window_boundary_inclusivity(self, events_backend):
+        """ISSUE 10 satellite: the refresh loop's gap/overlap-free window
+        contract — ``start_time`` INCLUSIVE, ``until_time`` EXCLUSIVE —
+        pinned identical across every backend.  A generation trained
+        with ``until_time=W`` plus a delta trained with
+        ``start_time=W`` must cover every event exactly once, including
+        one stamped exactly at W."""
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("a", "u1", "2026-01-01T00:00:00"),
+                _mk("b", "u1", "2026-01-02T00:00:00"),   # exactly at W
+                _mk("c", "u1", "2026-01-03T00:00:00"),
+            ],
+            APP,
+        )
+        w = ts("2026-01-02T00:00:00")
+        before = [e.event for e in ev.find(APP, until_time=w)]
+        after = [e.event for e in ev.find(APP, start_time=w)]
+        assert before == ["a"], "until_time must be EXCLUSIVE"
+        assert after == ["b", "c"], "start_time must be INCLUSIVE"
+        assert sorted(before + after) == ["a", "b", "c"]  # no gap/overlap
+        # the columnar (training) read follows the same contract
+        tbl = ev.find_columnar(APP, start_time=w)
+        assert tbl.num_rows == 2
+        tbl = ev.find_columnar(APP, until_time=w)
+        assert tbl.num_rows == 1
+
+    def test_time_window_naive_bounds_mean_utc(self, events_backend):
+        """A NAIVE window bound means the same instant as the aware-UTC
+        stamp on every backend (the shared epoch_us rule) — a daemon
+        passing datetime.utcnow() must not shift or crash anywhere."""
+        ev = events_backend
+        ev.init(APP)
+        ev.insert_batch(
+            [
+                _mk("a", "u1", "2026-01-01T00:00:00"),
+                _mk("b", "u1", "2026-01-02T00:00:00"),
+            ],
+            APP,
+        )
+        naive = dt.datetime(2026, 1, 2)  # no tzinfo → means UTC
+        assert [e.event for e in ev.find(APP, start_time=naive)] == ["b"]
+        assert [e.event for e in ev.find(APP, until_time=naive)] == ["a"]
+
+    def test_equal_event_times_order_by_creation(self, events_backend):
+        """Ties on event_time order by creation_time everywhere — the
+        watermark contract needs ONE deterministic order, not a
+        per-backend one."""
+        ev = events_backend
+        ev.init(APP)
+        t = ts("2026-01-01T00:00:00")
+        for name, created in (("first", "2026-01-01T10:00:00"),
+                              ("second", "2026-01-01T11:00:00")):
+            ev.insert(Event(event=name, entity_type="user", entity_id="u1",
+                            event_time=t, creation_time=ts(created)), APP)
+        assert [e.event for e in ev.find(APP)] == ["first", "second"]
+        assert [e.event for e in ev.find(APP, reversed=True)] == \
+            ["second", "first"]
+
+    def test_latest_event_time(self, events_backend):
+        """Ingest high-watermark (ISSUE 10): max event_time, None when
+        empty, channel-scoped — every backend."""
+        ev = events_backend
+        ev.init(APP)
+        assert ev.latest_event_time(APP) is None
+        ev.insert_batch(
+            [
+                _mk("a", "u1", "2026-01-02T00:00:00"),
+                _mk("b", "u1", "2026-01-05T00:00:00"),
+                _mk("c", "u1", "2026-01-03T00:00:00"),
+            ],
+            APP,
+        )
+        assert ev.latest_event_time(APP) == ts("2026-01-05T00:00:00")
+        ev.init(APP, channel_id=2)
+        assert ev.latest_event_time(APP, 2) is None
+        ev.insert(_mk("d", "u1", "2026-02-01T00:00:00"), APP, channel_id=2)
+        assert ev.latest_event_time(APP, 2) == ts("2026-02-01T00:00:00")
+        assert ev.latest_event_time(APP) == ts("2026-01-05T00:00:00")
+
     def test_channel_isolation(self, events_backend):
         ev = events_backend
         ev.init(APP)

@@ -102,6 +102,86 @@ def test_watchdog_disabled_without_env(pio_home, monkeypatch):
     assert wd.poll() is False
 
 
+# -- PIO_STEP_TIMEOUT_KILL hard escalation (ISSUE 10 satellite) --------------
+
+def test_kill_escalates_when_abort_cannot_unwind(pio_home):
+    """A fired watchdog whose abort never unwinds (runtime wedged in a C
+    call) hard-kills after the grace period — exactly once, with the
+    metric and trace event."""
+    from predictionio_tpu.obs import get_recorder, get_registry
+
+    clock = FakeClock()
+    actions = []
+    wd = StepWatchdog("als", timeout_s=10.0, kill_grace_s=20.0, clock=clock,
+                      abort_fn=lambda: actions.append("abort"),
+                      kill_fn=lambda: actions.append("KILL"),
+                      poll_interval_s=0)
+    wd.arm(5)
+    clock.t += 11.0
+    assert wd.poll() is True          # soft fire
+    assert actions == ["abort"]
+    clock.t += 19.0                    # inside the grace window
+    assert wd.poll() is False
+    assert actions == ["abort"]
+    clock.t += 2.0                     # grace expired, still not unwound
+    assert wd.poll() is True
+    assert actions == ["abort", "KILL"]
+    assert wd.poll() is False, "kills exactly once"
+    assert actions == ["abort", "KILL"]
+    counter = get_registry().counter(
+        "pio_watchdog_killed_total", "", ("fn",))
+    assert counter.value(fn="als") == 1
+    killed = [t for t in get_recorder().recent(10)
+              if t["name"] == "watchdog.killed"]
+    assert killed and killed[0]["attrs"]["graceS"] == 20.0
+
+
+def test_kill_stands_down_when_run_unwinds(pio_home):
+    """stop() (the training loop's finally) IS the unwind signal: a run
+    the soft abort successfully tore down never escalates."""
+    clock = FakeClock()
+    actions = []
+    wd = StepWatchdog("als", timeout_s=10.0, kill_grace_s=20.0, clock=clock,
+                      abort_fn=lambda: actions.append("abort"),
+                      kill_fn=lambda: actions.append("KILL"),
+                      poll_interval_s=0)
+    wd.arm(5)
+    clock.t += 11.0
+    assert wd.poll() is True
+    wd.stop()                          # the abort unwound the loop
+    clock.t += 1000.0
+    assert wd.poll() is False
+    assert actions == ["abort"]
+
+
+def test_kill_disabled_by_default(pio_home, monkeypatch):
+    """No PIO_STEP_TIMEOUT_KILL → never escalates, however long the
+    wedge lasts (the pre-ISSUE-10 behavior is the default)."""
+    monkeypatch.delenv("PIO_STEP_TIMEOUT_KILL", raising=False)
+    clock = FakeClock()
+    actions = []
+    wd = StepWatchdog("als", timeout_s=10.0, clock=clock,
+                      abort_fn=lambda: actions.append("abort"),
+                      kill_fn=lambda: actions.append("KILL"),
+                      poll_interval_s=0)
+    assert wd.kill_grace_s == 0.0
+    wd.arm(5)
+    clock.t += 11.0
+    assert wd.poll() is True
+    clock.t += 1e6
+    assert wd.poll() is False
+    assert actions == ["abort"]
+
+
+def test_kill_grace_reads_env(pio_home, monkeypatch):
+    monkeypatch.setenv("PIO_STEP_TIMEOUT_KILL", "45")
+    wd = StepWatchdog("als", timeout_s=1.0, poll_interval_s=0)
+    assert wd.kill_grace_s == 45.0
+    monkeypatch.setenv("PIO_STEP_TIMEOUT_KILL", "nonsense")
+    wd = StepWatchdog("als", timeout_s=1.0, poll_interval_s=0)
+    assert wd.kill_grace_s == 0.0
+
+
 # -- divergence guard --------------------------------------------------------
 
 def test_guard_allows_finite_and_bounds_rollbacks(pio_home):

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Static check: the refresh loop promotes ONLY through the staged-reload
+canary gate — never a direct model write or generation swap.
+
+ISSUE 10 builds a daemon that retrains continuously.  The single most
+dangerous regression such a loop can grow is a shortcut around the PR-4
+promotion machinery: writing a model blob the serving layer will load
+without validation, or reaching into a live EngineServer and swapping
+its generation state directly (skipping the finite check, the canary
+queries, and the retained-rollback slot).  This lint makes the road
+structural (a tier-1 test runs it in CI):
+
+1. **Model-store writes** — a ``<x>.get_models().insert(...)`` chain (or
+   any ``.insert`` call on a variable bound from ``get_models()``) is
+   allowed ONLY in ``workflow/core_workflow.py`` (``_persist_models``,
+   the one sanctioned writer) and in ``data/storage`` backends (the
+   repositories themselves).  Everything else — the refresh daemon
+   especially — trains through ``run_train`` and promotes through
+   ``POST /reload``.
+
+2. **Generation-state writes** — assignments to the engine server's
+   swap-guarded fields (``_models``, ``_algorithms``, ``_serving``,
+   ``_instance``, ``_previous``, ``_generation``) on an object other
+   than ``self`` are allowed ONLY in ``server/engine_server.py``.  A
+   module that mutates another object's generation state is bypassing
+   the staged reload.
+
+3. **Refresh-package discipline** — ``predictionio_tpu/refresh``
+   additionally must not call ``load_models``-then-serve shortcuts:
+   it may not reference ``validate_model_finite`` (validation belongs
+   to the server's gate, not a daemon-side reimplementation) and may
+   not call ``get_models`` at all.
+
+Usage: ``python tools/lint_refresh.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# Files allowed to write the model store (rule 1).
+_MODEL_WRITE_OK = {
+    ("workflow", "core_workflow.py"),
+}
+# Generation-state attributes only engine_server.py may assign on a
+# non-self object (rule 2).
+_GEN_ATTRS = {"_models", "_algorithms", "_serving", "_instance",
+              "_previous", "_generation"}
+_GEN_WRITE_OK = {("server", "engine_server.py")}
+# Names the refresh package may not touch (rule 3).
+_REFRESH_FORBIDDEN = {"get_models", "validate_model_finite"}
+
+
+def _rel_key(path: Path) -> tuple:
+    return (path.parent.name, path.name)
+
+
+def _is_get_models_chain(call: ast.Call) -> bool:
+    """``<anything>.get_models(...).insert(...)`` — the direct chain."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "insert"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "get_models")
+
+
+def _get_models_bound_names(tree: ast.AST) -> set:
+    """Variables assigned from a ``get_models()`` call anywhere in the
+    module — ``repo = storage.get_models(); repo.insert(...)`` must not
+    dodge rule 1 by splitting the chain."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "get_models":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def check_source(source: str, filename: str,
+                 rel_key: tuple, in_refresh: bool) -> List[str]:
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+
+    model_write_ok = rel_key in _MODEL_WRITE_OK \
+        or rel_key[0] == "storage"
+    bound = _get_models_bound_names(tree)
+    for node in ast.walk(tree):
+        # Rule 1: model-store writes.
+        if isinstance(node, ast.Call) and not model_write_ok:
+            direct = _is_get_models_chain(node)
+            via_name = (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "insert"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in bound)
+            if direct or via_name:
+                violations.append(
+                    f"{filename}:{node.lineno}: direct model-store write "
+                    f"(get_models().insert) — models are persisted only "
+                    f"by workflow.core_workflow and promoted through the "
+                    f"staged-reload gate")
+        # Rule 2: generation-state assignment on a non-self object.
+        if isinstance(node, (ast.Assign, ast.AugAssign)) \
+                and rel_key not in _GEN_WRITE_OK:
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in _GEN_ATTRS
+                        and not (isinstance(tgt.value, ast.Name)
+                                 and tgt.value.id == "self")):
+                    violations.append(
+                        f"{filename}:{node.lineno}: assigns "
+                        f"<obj>.{tgt.attr} — engine-server generation "
+                        f"state swaps only inside "
+                        f"server/engine_server.py (staged reload / "
+                        f"rollback)")
+        # Rule 3: refresh-package discipline.
+        if in_refresh:
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name in _REFRESH_FORBIDDEN:
+                violations.append(
+                    f"{filename}:{node.lineno}: refresh/ references "
+                    f"{name!r} — promotion goes through the serving "
+                    f"server's staged-reload gate (POST /reload), never "
+                    f"a daemon-side model write or validation shortcut")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    pkg = root / "predictionio_tpu"
+    violations: List[str] = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = _rel_key(path)
+        in_refresh = path.parent.name == "refresh"
+        violations.extend(check_source(
+            path.read_text(encoding="utf-8"), str(path), rel, in_refresh))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} refresh-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_refresh: all model promotion rides the staged-reload gate.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -755,12 +755,15 @@ def _corpus_scale(args) -> None:
 # --------------------------------------------------------------------------
 
 def _drive_until(port: int, n_users: int, clients: int,
-                 stop_event: "threading.Event"):
+                 stop_event: "threading.Event", tight_budgets: bool = True):
     """Closed-loop drive that runs UNTIL ``stop_event`` (the refresh
     cycle completing) — the percentiles cover exactly the window a
     promotion swaps generations under load.  Every request carries a
     deadline header; a 200 whose server-attested remaining budget is
-    negative counts as a served-late violation (must be 0)."""
+    negative counts as a served-late violation (must be 0).
+    ``tight_budgets=False`` sends only generous budgets — the quality
+    round's claim is zero non-2xx across the whole episode, so the
+    drive must not shed by design."""
     import socket
 
     rng = np.random.default_rng(3)
@@ -768,7 +771,7 @@ def _drive_until(port: int, n_users: int, clients: int,
                   for u in rng.integers(0, n_users, 512)]
     raws = []
     for i, p in enumerate(payload_of):
-        budget = 2000 if i % 4 else 150
+        budget = 2000 if (i % 4 or not tight_budgets) else 150
         raws.append(b"POST /queries.json HTTP/1.1\r\nHost: b\r\n"
                     b"Content-Type: application/json\r\n"
                     b"X-PIO-Deadline-Ms: " + str(budget).encode()
@@ -1027,6 +1030,197 @@ def _refresh_round(args) -> None:
         print(f"wrote {args.out}")
 
 
+def _quality_round(args) -> None:
+    """ISSUE 11 round: (a) the serving-overhead record — p99 at c=N with
+    the quality layer at its SHIPPED defaults (PIO_QUALITY_SAMPLE=0.1 +
+    an armed shadow session) vs PIO_QUALITY_SAMPLE=0 on an identical
+    server/model — the ≤5% acceptance; plus an honest worst-case row at
+    full sampling (every request sampled AND shadow-eligible — no
+    claim, this box shares one core between serving and the shadow
+    worker); (b) a DRIVEN drift→rollback episode: a score-shifted
+    candidate is promoted through the canary gate under load, the
+    QUALITY gate detects it (PSI over threshold on both windows, the
+    SLO objectives deliberately de-tuned so only quality can trip) and
+    rolls back via /admin/rollback — detection latency and zero
+    non-2xx attested."""
+    import urllib.request as ur
+
+    from predictionio_tpu.refresh import RefreshConfig
+    from predictionio_tpu.refresh.daemon import HttpPromoter, RefreshDaemon
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.server import engine_server as es_mod
+    from predictionio_tpu.controller import RuntimeContext
+
+    # The episode's verdict must come from the QUALITY gate: de-tune the
+    # SLO so the bench's own load shape (closed-loop c=32 on one shared
+    # core) can never trip the burn-rate rollback first — same
+    # calibration discipline as the --refresh round.
+    os.environ["PIO_SLO_AVAILABILITY"] = "0.9"
+    os.environ["PIO_SLO_LATENCY_TARGET_MS"] = "10000"
+
+    def _server_and_drive(sample: str, reload_first: bool):
+        os.environ["PIO_QUALITY_SAMPLE"] = sample
+        srv = EngineServer(eng, variant, storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        if reload_first:
+            # retain a previous generation → the shadow session arms,
+            # so sampled requests are also shadow-score-eligible
+            req = ur.Request(f"http://127.0.0.1:{srv.port}/reload",
+                             data=b"", method="POST")
+            with ur.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+        _drive(srv.port, n_users, args.clients, args.requests)  # warmup
+        res = _drive(srv.port, n_users, args.clients, args.requests)
+        return srv, res
+
+    # Phase A — baseline: quality sampling OFF (the rate knob, not the
+    # kill switch: the per-request draw + sample check stay in).
+    eng, variant, storage, n_users = _setup("twotower")
+    ctx = RuntimeContext.create(storage=storage)
+    srv, off = _server_and_drive("0", reload_first=False)
+    srv.stop()
+
+    # Phase B — shipped defaults + armed shadow: THE ≤5% claim.
+    srv, on_default = _server_and_drive("0.1", reload_first=True)
+    srv.stop()
+
+    # Phase C — full sampling worst case (recorded, no claim).
+    srv, on_full = _server_and_drive("1.0", reload_first=True)
+    with ur.urlopen(f"http://127.0.0.1:{srv.port}/quality.json",
+                    timeout=10) as r:
+        qdoc_overhead = json.loads(r.read())
+
+    def _delta(a, b):
+        return (round(100.0 * (b["p99_ms"] - a["p99_ms"]) / a["p99_ms"],
+                      2) if a.get("p99_ms") else None)
+
+    p99_delta_pct = _delta(off, on_default)
+    p99_delta_full_pct = _delta(off, on_full)
+
+    # Phase D — the driven drift→rollback episode on the full-sampling
+    # server: poison the candidate load with a user-side 4× scale
+    # (scores shift; ranking and the scorecard's item-corpus
+    # fingerprint stay intact, so ONLY the drift detector can catch
+    # it).
+    real_load = es_mod.load_models
+
+    def shifted(engine_, instance, c=None):
+        models = real_load(engine_, instance, c)
+        models[0].user_vecs = np.asarray(models[0].user_vecs) * 4.0
+        return models
+
+    es_mod.load_models = shifted
+
+    class TimedPromoter(HttpPromoter):
+        t_promoted = None
+        t_rollback = None
+        trip_doc = None
+
+        def promote(self, instance_id):
+            out = super().promote(instance_id)
+            self.t_promoted = time.perf_counter()
+            return out
+
+        def quality_state(self):
+            doc = super().quality_state()
+            if (doc.get("gate") or {}).get("rollback"):
+                # the document that tripped — captured BEFORE the
+                # rollback re-anchors the detector on the restored
+                # generation
+                self.trip_doc = doc
+            return doc
+
+        def rollback(self):
+            self.t_rollback = time.perf_counter()
+            super().rollback()
+
+    promoter = TimedPromoter(f"http://127.0.0.1:{srv.port}",
+                             canary_window_s=120.0, canary_poll_s=0.2)
+    daemon = RefreshDaemon(
+        eng, variant, ctx,
+        config=RefreshConfig(interval_s=1.0, eval_tolerance=10.0),
+        promoter=promoter)
+    gen_before = json.loads(ur.urlopen(
+        f"http://127.0.0.1:{srv.port}/", timeout=10).read())
+    episode_done = threading.Event()
+    cycle = {}
+
+    def run_cycle():
+        t0 = time.perf_counter()
+        try:
+            cycle.update(daemon.run_once())
+        finally:
+            cycle["wall_s"] = round(time.perf_counter() - t0, 2)
+            episode_done.set()
+
+    drive_box = {}
+    driver = threading.Thread(
+        target=lambda: drive_box.update(_drive_until(
+            srv.port, n_users, args.clients, episode_done,
+            tight_budgets=False)),
+        daemon=True)
+    driver.start()
+    time.sleep(0.5)            # steady state before the promotion
+    run_cycle()
+    driver.join(30)
+    gen_after = json.loads(ur.urlopen(
+        f"http://127.0.0.1:{srv.port}/", timeout=10).read())
+    srv.stop()
+    es_mod.load_models = real_load
+
+    trip = promoter.trip_doc or {}
+    non_2xx = sum(n for s, n in drive_box.get("statuses", {}).items()
+                  if not s.startswith("2"))
+    record = {
+        "mode": "quality",
+        "engine": "twotower",
+        "clients": args.clients,
+        "requests_per_phase": args.requests,
+        "slo_detuned_for_episode": {
+            "PIO_SLO_AVAILABILITY": 0.9,
+            "PIO_SLO_LATENCY_TARGET_MS": 10000,
+        },
+        "overhead": {
+            "quality_off": off,
+            "quality_defaults_plus_shadow": on_default,
+            "quality_full_sampling_plus_shadow": on_full,
+            "p99_delta_pct": p99_delta_pct,
+            "p99_delta_within_5pct": (p99_delta_pct is not None
+                                      and p99_delta_pct <= 5.0),
+            "p99_delta_full_sampling_pct": p99_delta_full_pct,
+            "sampled_total_full": qdoc_overhead.get("sampling", {})
+            .get("sampledTotal"),
+            "shadow_scored_full": qdoc_overhead.get("shadow", {})
+            .get("scored"),
+        },
+        "drift_episode": {
+            "injection": "user_vecs x4 at candidate load (scores shift, "
+                         "ranking + corpus fingerprint intact)",
+            "promotion": cycle.get("promotion"),
+            "cycle_wall_s": cycle.get("wall_s"),
+            "detect_to_rollback_s": (
+                round(promoter.t_rollback - promoter.t_promoted, 2)
+                if promoter.t_rollback and promoter.t_promoted else None),
+            "generation_before": gen_before.get("modelGeneration"),
+            "generation_after": gen_after.get("modelGeneration"),
+            "served_instance_restored": (
+                gen_after.get("engineInstanceId")
+                == gen_before.get("engineInstanceId")),
+            "gate_reasons_at_trip": (trip.get("gate") or {})
+            .get("reasons"),
+            "drift_at_trip": trip.get("drift"),
+            "query_during_episode": drive_box,
+            "non_2xx_during_episode": non_2xx,
+        },
+    }
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.out}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -1059,10 +1253,20 @@ def main():
                     default=5000,
                     help="delta events ingested before the warm refresh "
                          "(refresh mode; default 5000 = 5%% of corpus)")
+    ap.add_argument("--quality", action="store_true",
+                    help="ISSUE 11 round: p99 overhead of full quality "
+                         "sampling + an armed shadow session vs "
+                         "PIO_QUALITY_SAMPLE=0 (≤5%% attested), then a "
+                         "driven drift→rollback episode (score-shifted "
+                         "candidate promoted under load, detected by "
+                         "the PSI gate, rolled back with zero non-2xx)")
     ap.add_argument("--out", default=None,
                     help="write the corpus-scale record to this JSON file")
     args = ap.parse_args()
 
+    if args.quality:
+        _quality_round(args)
+        return
     if args.refresh:
         _refresh_round(args)
         return

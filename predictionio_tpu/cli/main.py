@@ -254,6 +254,7 @@ def _print_serving_snapshot(lines) -> None:
     latest_ts = {}
     staleness = None
     refresh_runs = {}
+    quality = {}
 
     def _b(model):
         return batcher.setdefault(model, {})
@@ -267,6 +268,22 @@ def _print_serving_snapshot(lines) -> None:
             staleness = value
         elif name == "pio_refresh_runs_total" and value > 0:
             refresh_runs[labels.get("result", "?")] = int(value)
+        elif name == "pio_quality_drift":
+            quality.setdefault("drift", {})[
+                f"{labels.get('metric', '?')}_{labels.get('window', '?')}"
+            ] = value
+        elif name == "pio_quality_drift_tripped":
+            quality["tripped"] = bool(value)
+        elif name == "pio_quality_reporting_only" and value > 0:
+            quality["reporting_only"] = True
+        elif name == "pio_quality_shadow_overlap":
+            quality["shadow_overlap"] = value
+        elif name == "pio_quality_online_hit_rate":
+            quality["hit_rate"] = value
+        elif name == "pio_quality_gate_rollback":
+            quality["gate_rollback"] = bool(value)
+        elif name == "pio_quality_sampled_total" and value > 0:
+            quality["sampled"] = int(value)
         elif name == "pio_model_reload_total":
             reloads[labels.get("result", "?")] = int(value)
         elif name == "pio_breaker_state":
@@ -290,7 +307,8 @@ def _print_serving_snapshot(lines) -> None:
             shed = _b(labels.get("model", "?")).setdefault("shed", {})
             shed[labels.get("reason", "?")] = int(value)
     if generation is None and not reloads and not breakers and not batcher \
-            and not latest_ts and not refresh_runs and staleness is None:
+            and not latest_ts and not refresh_runs and staleness is None \
+            and not quality:
         return
     if generation is not None:
         print(f"serving: model generation {generation}")
@@ -305,6 +323,28 @@ def _print_serving_snapshot(lines) -> None:
     if refresh_runs:
         parts = ", ".join(f"{k}={v}" for k, v in sorted(refresh_runs.items()))
         print(f"  refresh runs: {parts}")
+    # Model quality (ISSUE 11): drift vs the training scorecard, shadow
+    # canary overlap, online hit-rate, and the promotion-gate verdict.
+    if quality:
+        parts = []
+        drift = quality.get("drift", {})
+        if "psi_fast" in drift or "psi_slow" in drift:
+            parts.append(f"psi fast={drift.get('psi_fast', 0):.3f}"
+                         f"/slow={drift.get('psi_slow', 0):.3f}")
+        if quality.get("tripped"):
+            parts.append("DRIFT TRIPPED")
+        if quality.get("reporting_only"):
+            parts.append("reporting-only (no trusted scorecard)")
+        if "shadow_overlap" in quality:
+            parts.append(f"shadow overlap {quality['shadow_overlap']:.2f}")
+        if "hit_rate" in quality:
+            parts.append(f"online hit-rate {quality['hit_rate']:.3f}")
+        if quality.get("gate_rollback"):
+            parts.append("GATE=ROLLBACK")
+        if "sampled" in quality:
+            parts.append(f"sampled {quality['sampled']}")
+        if parts:
+            print(f"  quality: {', '.join(parts)}")
     if reloads:
         parts = ", ".join(f"{k}={v}" for k, v in sorted(reloads.items()))
         print(f"  model reloads: {parts}")
@@ -577,6 +617,8 @@ def _train_follow(args, engine, variant, ctx) -> int:
         interval_s=getattr(args, "refresh_interval", None),
         promote_url=getattr(args, "promote_url", None),
         canary_window_s=getattr(args, "canary_window", None),
+        trigger_staleness_s=getattr(args, "trigger_staleness", None),
+        trigger_delta_count=getattr(args, "trigger_delta_count", None),
     )
     daemon = RefreshDaemon(engine, variant, ctx, config=cfg)
 
@@ -594,8 +636,19 @@ def _train_follow(args, engine, variant, ctx) -> int:
             continue
     where = f", promoting via {cfg.promote_url}" if cfg.promote_url else \
         " (no promote URL — serving servers reload on their own)"
-    print(f"Refresh daemon: retraining every {cfg.interval_s:g}s{where}. "
-          "Ctrl-C to stop.")
+    if cfg.trigger_staleness_s is not None \
+            or cfg.trigger_delta_count is not None:
+        trig = []
+        if cfg.trigger_staleness_s is not None:
+            trig.append(f"staleness≥{cfg.trigger_staleness_s:g}s")
+        if cfg.trigger_delta_count is not None:
+            trig.append(f"delta≥{cfg.trigger_delta_count} events")
+        print(f"Refresh daemon: trigger mode ({' or '.join(trig)}, "
+              f"backstop every {cfg.interval_s:g}s){where}. Ctrl-C to "
+              "stop.")
+    else:
+        print(f"Refresh daemon: retraining every {cfg.interval_s:g}s"
+              f"{where}. Ctrl-C to stop.")
     try:
         cycles = daemon.follow()
     except TrainPreempted as e:
@@ -1325,6 +1378,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="post-promotion SLO-burn watch window; a trip "
                         "rolls the promotion back (default env "
                         "PIO_REFRESH_CANARY_WINDOW_S, else 60; 0 = off)")
+    t.add_argument("--trigger-staleness", dest="trigger_staleness",
+                   type=float, default=None, metavar="S",
+                   help="follow-mode trigger: fire a refresh cycle when "
+                        "event→servable staleness crosses S seconds "
+                        "(default env PIO_REFRESH_TRIGGER_STALENESS_S; "
+                        "the --refresh-interval cadence becomes a "
+                        "backstop ceiling)")
+    t.add_argument("--trigger-delta-count", dest="trigger_delta_count",
+                   type=int, default=None, metavar="N",
+                   help="follow-mode trigger: fire a refresh cycle when "
+                        "N events have landed past the served watermark "
+                        "(default env PIO_REFRESH_TRIGGER_DELTA_COUNT)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="evaluate engine-params candidates")

@@ -25,6 +25,10 @@ Three parts, one process-wide state:
 - :mod:`predictionio_tpu.obs.fleet` — Prometheus-text parsing and the
   type-correct multi-instance merge behind ``/fleet.json`` /
   ``pio status --fleet``.
+- :mod:`predictionio_tpu.obs.quality` — model-quality observability:
+  sampled prediction stream, scorecard drift (PSI/KL), shadow-scored
+  canaries, feedback-joined online hit-rate, and the ``/quality.json``
+  promotion gate.
 
 stdlib-only on import: safe from the CLI, the servers, and the data layer
 without touching jax/numpy.
@@ -137,3 +141,7 @@ def reset_observability() -> None:
     # the leaked collector so the NEXT test's contextvar view is clean.
     from predictionio_tpu.obs import waterfall as _waterfall
     _waterfall.deactivate()
+    # The feedback joiner is process-global (engine notes serves, event
+    # server joins) — drop it with the registry its counters lived in.
+    from predictionio_tpu.obs.quality import reset_quality
+    reset_quality()

@@ -245,7 +245,7 @@ def fleet_instances_from_env(env=None) -> List[str]:
 
 class _InstanceState:
     __slots__ = ("url", "types", "samples", "stats", "timeline",
-                 "last_ok_at", "error")
+                 "quality", "last_ok_at", "error")
 
     def __init__(self, url: str):
         self.url = url
@@ -253,6 +253,7 @@ class _InstanceState:
         self.samples: List[Tuple] = []
         self.stats: Optional[Dict[str, Any]] = None
         self.timeline: Optional[Dict[str, Any]] = None
+        self.quality: Optional[Dict[str, Any]] = None
         self.last_ok_at: Optional[float] = None
         self.error: Optional[str] = None
 
@@ -305,9 +306,17 @@ class FleetAggregator:
                         f"{url}/timeline.json?format=summary"))
                 except Exception:  # noqa: BLE001 - timeline is optional
                     pass
+                quality = None
+                try:
+                    quality = json.loads(self._fetch(
+                        f"{url}/quality.json"))
+                except Exception:  # noqa: BLE001 - quality is optional
+                    pass
                 with self._lock:
                     st.types, st.samples = types, samples
                     st.stats, st.timeline = stats, timeline
+                    st.quality = quality if isinstance(quality, dict) \
+                        else None
                     st.last_ok_at = self._clock()
                     st.error = None
             except Exception as e:  # noqa: BLE001 - degrade to stale
@@ -338,6 +347,9 @@ class FleetAggregator:
         with self._lock:
             states = {u: (st.types, list(st.samples))
                       for u, st in self._state.items() if st.samples}
+            quality_docs = [st.quality for u in self.instances
+                            for st in (self._state[u],)
+                            if st.quality is not None]
             rows = []
             for u in self.instances:
                 st = self._state[u]
@@ -357,6 +369,8 @@ class FleetAggregator:
                         row["batcher"] = st.stats["batcher"]
                 if st.timeline:
                     row["timeline"] = st.timeline.get("models")
+                if st.quality is not None:
+                    row["quality"] = st.quality
                 rows.append(row)
             # Merge INSIDE the lock: the reset tracker mutates on every
             # merge, so a concurrent /fleet.json working from an older
@@ -370,6 +384,10 @@ class FleetAggregator:
                         "count": s["count"]}
                   for key, s in series.items()}
             for fam, series in merged["histograms"].items()}
+        # Quality merge (ISSUE 11): union-of-keys recursion — an
+        # instance's field is never silently dropped (tier-1 pinned).
+        from predictionio_tpu.obs.quality import merge_quality
+
         return {
             "scrapedAt": round(time.time(), 3),
             "instances": rows,
@@ -380,6 +398,7 @@ class FleetAggregator:
                            sorted(merged["gauges"].items())},
                 "histogramQuantiles": quantiles,
                 "histograms": merged["histograms"],
+                "quality": merge_quality(quality_docs),
             },
         }
 

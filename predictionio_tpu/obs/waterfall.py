@@ -100,7 +100,8 @@ def stage_histogram(registry=None):
 class Waterfall:
     """One request's stage collector (thread-safe, close-once)."""
 
-    __slots__ = ("stages", "attrs", "_lock", "_closed", "_marks")
+    __slots__ = ("stages", "attrs", "_lock", "_closed", "_marks",
+                 "sample_u")
 
     def __init__(self):
         self.stages: Dict[str, float] = {}
@@ -108,6 +109,26 @@ class Waterfall:
         self._lock = threading.Lock()
         self._closed = False
         self._marks: Dict[str, float] = {}
+        # THE request's shared uniform sample draw (ISSUE 11): set once
+        # by the engine handler; the wide-event log sampler
+        # (PIO_REQUEST_LOG_SAMPLE) and the prediction record stream
+        # (PIO_QUALITY_SAMPLE) each compare it against their own rate —
+        # one RNG draw per request, many thresholds.
+        self.sample_u: Optional[float] = None
+
+    def attr(self, name: str, default: Any = None) -> Any:
+        """One attribute under the lock (the engine handler reads the
+        generation the batcher stamped onto the dispatch)."""
+        with self._lock:
+            return self.attrs.get(name, default)
+
+    def note(self, **attrs) -> None:
+        """Attach attributes without a stage stamp (the serve id rides
+        here into the wide event AND to the transport's response-header
+        hook)."""
+        with self._lock:
+            if not self._closed:
+                self.attrs.update(attrs)
 
     def mark(self, name: str) -> None:
         """Record a wall-clock boundary (``time.perf_counter``) another
@@ -185,7 +206,7 @@ class Waterfall:
         }
         if attested_ms is not None:
             doc["serverMs"] = round(attested_ms, 3)
-        _request_log_write(doc)
+        _request_log_write(doc, self.sample_u)
         return doc
 
 
@@ -275,10 +296,34 @@ def record_stage(stage: str, ms: float, **attrs) -> None:
 _log_lock = threading.Lock()
 
 
-def _request_log_write(doc: Dict[str, Any]) -> None:
+def _log_sample_rate() -> float:
+    """``PIO_REQUEST_LOG_SAMPLE`` (default 1.0 = every request): the
+    wide-event log's share of requests.  Read per write, like the path —
+    an operator can turn a hot server's log down live."""
+    raw = os.environ.get("PIO_REQUEST_LOG_SAMPLE")
+    if raw is None or not str(raw).strip():
+        return 1.0
+    try:
+        return min(max(float(raw), 0.0), 1.0)
+    except (TypeError, ValueError):
+        return 1.0
+
+
+def _request_log_write(doc: Dict[str, Any],
+                       sample_u: Optional[float] = None) -> None:
     path = os.environ.get("PIO_REQUEST_LOG")
     if not path:
         return
+    rate = _log_sample_rate()
+    if rate < 1.0:
+        # One sampling decision per request: reuse the handler's shared
+        # draw when it made one (so the wide event and the prediction
+        # stream describe the SAME sampled population), else draw here.
+        import random as _random
+
+        u = sample_u if sample_u is not None else _random.random()
+        if u >= rate:
+            return
     line = json.dumps(doc, separators=(",", ":"))
     try:
         # Handle not cached: the path may change/rotate live (same

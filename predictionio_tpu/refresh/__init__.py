@@ -102,10 +102,28 @@ def _env_f(key: str, default: float) -> float:
         return default
 
 
+def _env_opt_f(key: str) -> Optional[float]:
+    raw = os.environ.get(key)
+    if raw is None or str(raw).strip() == "":
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return None
+
+
 @dataclasses.dataclass
 class RefreshConfig:
     """Refresh-loop knobs; :meth:`from_env` is the production
-    constructor (CLI flags override, same pattern as SchedulerConfig)."""
+    constructor (CLI flags override, same pattern as SchedulerConfig).
+
+    Trigger mode (ISSUE 11 satellite, carried since PR 10): with either
+    ``trigger_staleness_s`` or ``trigger_delta_count`` set, the daemon
+    fires a cycle when the event→servable staleness or the count of
+    events ingested past the served watermark crosses its threshold —
+    the freshness gauges become actuators — polling every
+    ``trigger_poll_s``, with the fixed ``interval_s`` cadence kept as a
+    backstop ceiling between cycles."""
 
     interval_s: float = 300.0
     max_delta_fraction: float = 0.5
@@ -113,9 +131,13 @@ class RefreshConfig:
     promote_url: Optional[str] = None
     canary_window_s: float = 60.0
     canary_poll_s: float = 2.0
+    trigger_staleness_s: Optional[float] = None
+    trigger_delta_count: Optional[int] = None
+    trigger_poll_s: float = 5.0
 
     @classmethod
     def from_env(cls, **overrides) -> "RefreshConfig":
+        delta_n = _env_opt_f("PIO_REFRESH_TRIGGER_DELTA_COUNT")
         cfg = cls(
             interval_s=_env_f("PIO_REFRESH_INTERVAL_S", 300.0),
             max_delta_fraction=_env_f("PIO_REFRESH_MAX_DELTA_FRACTION", 0.5),
@@ -123,6 +145,11 @@ class RefreshConfig:
             promote_url=(os.environ.get("PIO_REFRESH_PROMOTE_URL") or None),
             canary_window_s=_env_f("PIO_REFRESH_CANARY_WINDOW_S", 60.0),
             canary_poll_s=_env_f("PIO_REFRESH_CANARY_POLL_S", 2.0),
+            trigger_staleness_s=_env_opt_f(
+                "PIO_REFRESH_TRIGGER_STALENESS_S"),
+            trigger_delta_count=(int(delta_n) if delta_n is not None
+                                 else None),
+            trigger_poll_s=_env_f("PIO_REFRESH_TRIGGER_POLL_S", 5.0),
         )
         for k, v in overrides.items():
             if v is not None:
@@ -178,3 +205,7 @@ class RefreshMetrics:
             "pio_refresh_train_s",
             "Wall seconds of the last refresh train run by mode.",
             ("mode",))
+        self.triggers = reg.counter(
+            "pio_refresh_triggers_total",
+            "Trigger-mode refresh firings by reason (staleness / "
+            "delta_count / interval).", ("reason",))

@@ -126,6 +126,17 @@ class HttpPromoter:
                              opener=self._opener)
         return body.get("slo") or {}
 
+    def quality_state(self) -> Dict[str, Any]:
+        """The server's ``/quality.json`` gate document (ISSUE 11).
+        Empty on any failure — an old server without the endpoint, or a
+        poll blip, must never trip a rollback."""
+        try:
+            _, body = _http_json(self.base_url + "/quality.json",
+                                 opener=self._opener)
+        except Exception:
+            return {}
+        return body if isinstance(body, dict) else {}
+
     def served_watermark(self):
         """The data watermark of the generation the server is ACTUALLY
         serving right now — the authoritative anchor for the staleness
@@ -145,13 +156,24 @@ class HttpPromoter:
         return max(float(fast.get("availability", 0.0)),
                    float(fast.get("latency", 0.0))) >= thr
 
+    @staticmethod
+    def _quality_tripped(quality: Dict[str, Any]) -> bool:
+        """The server-side quality gate verdict (ISSUE 11): drift over
+        threshold on both windows, or shadow-canary divergence — with
+        the cold-app pass-through and the PIO_QUALITY_GATE switch
+        already applied by the server."""
+        gate = quality.get("gate") or {}
+        return bool(gate.get("rollback"))
+
     def rollback(self) -> None:
         _http_json(self.base_url + "/admin/rollback", "POST",
                    opener=self._opener)
 
     def canary_watch(self) -> str:
-        """Poll the server's SLO state for the canary window; roll back
-        on a burn trip.  Returns ``"promoted"`` or ``"rolled_back"``."""
+        """Poll the server's SLO *and* quality state for the canary
+        window; roll back when either trips — a promotion that burns
+        prediction quality rolls back exactly as one that burns the
+        latency SLO.  Returns ``"promoted"`` or ``"rolled_back"``."""
         deadline = self._clock() + self.canary_window_s
         while self._clock() < deadline:
             try:
@@ -164,6 +186,15 @@ class HttpPromoter:
                 logger.warning("SLO burn tripped inside the canary window "
                                "(%s) — rolling the promotion back",
                                slo.get("tripReasons") or "degraded")
+                self.rollback()
+                return "rolled_back"
+            quality = self.quality_state()
+            if self._quality_tripped(quality):
+                logger.warning(
+                    "quality gate tripped inside the canary window (%s) — "
+                    "rolling the promotion back",
+                    (quality.get("gate") or {}).get("reasons")
+                    or "degraded")
                 self.rollback()
                 return "rolled_back"
             self._sleep(self.canary_poll_s)
@@ -200,6 +231,10 @@ class RefreshDaemon:
         # app's ingest high-watermark against the served window.
         ds = (variant.raw.get("datasource") or {}).get("params") or {}
         self.app_name = ds.get("appName")
+        # The served generation's watermark, refreshed every cycle (and
+        # every trigger poll): the anchor both the staleness gauge and
+        # the trigger thresholds measure against.
+        self._served_wm = None
 
     # -- one cycle ----------------------------------------------------------
 
@@ -307,21 +342,97 @@ class RefreshDaemon:
         else:
             wm = data_watermark(trained_instance) \
                 if trained_instance is not None else None
+        self._served_wm = wm
+        self._publish_current_staleness()
+
+    def _publish_current_staleness(self):
+        """Staleness vs the last-known served watermark; returns the
+        reading (None when either side is unknown).  Trigger mode calls
+        this every poll, so the gauge tracks at poll cadence instead of
+        once per cycle."""
+        if not self.app_name:
+            return None
         try:
             latest = self.ctx.event_store.latest_event_time(self.app_name)
         except Exception:
             logger.debug("staleness probe failed", exc_info=True)
-            return
-        s = staleness_s(latest, wm)
+            return None
+        s = staleness_s(latest, self._served_wm)
         if s is not None:
             self.metrics.staleness.set(s)
+        return s
+
+    # -- trigger mode (ISSUE 11 satellite, carried since PR 10) -------------
+
+    def _trigger_mode(self) -> bool:
+        return (self.config.trigger_staleness_s is not None
+                or self.config.trigger_delta_count is not None)
+
+    def _delta_count(self, cap: int) -> int:
+        """Events ingested past the served watermark, counted up to
+        ``cap`` (the threshold) — the read never scans further than the
+        decision needs."""
+        if not self.app_name or self._served_wm is None:
+            return 0
+        try:
+            it = self.ctx.event_store.find(
+                self.app_name, start_time=self._served_wm, limit=cap)
+            return sum(1 for _ in it)
+        except Exception:
+            logger.debug("delta-count probe failed", exc_info=True)
+            return 0
+
+    def _trigger_ready(self, cycle_started: float):
+        """(fire?, reason) — staleness or delta-count threshold crossed,
+        or the fixed-cadence backstop elapsed."""
+        cfg = self.config
+        if self._clock() - cycle_started >= cfg.interval_s:
+            return True, "interval"
+        if cfg.trigger_staleness_s is not None:
+            s = self._publish_current_staleness()
+            if s is not None and s >= cfg.trigger_staleness_s:
+                return True, "staleness"
+        if cfg.trigger_delta_count is not None:
+            cap = max(int(cfg.trigger_delta_count), 1)
+            if self._delta_count(cap) >= cap:
+                return True, "delta_count"
+        return False, None
+
+    def _await_trigger(self, sleep: Optional[Callable[[float], None]]
+                       ) -> Optional[str]:
+        """Poll the trigger conditions until one fires (returns its
+        reason) or the daemon is stopped (returns None).  The freshness
+        gauges become actuators: a quiet app idles past its cadence-free
+        poll loop; a burst of events or a staleness breach fires a cycle
+        within one poll tick."""
+        from predictionio_tpu.resilience.supervision import (
+            preemption_requested,
+        )
+
+        started = self._clock()
+        poll = max(self.config.trigger_poll_s, 0.01)
+        while not self.stop_event.is_set() and not preemption_requested():
+            fire, reason = self._trigger_ready(started)
+            if fire:
+                self.metrics.triggers.inc(reason=reason)
+                publish_event("refresh.trigger", reason=reason)
+                logger.info("refresh trigger fired: %s", reason)
+                return reason
+            if sleep is not None:
+                sleep(poll)
+            elif self.stop_event.wait(poll):
+                return None
+        return None
 
     # -- follow mode --------------------------------------------------------
 
     def follow(self, sleep: Callable[[float], None] = None) -> int:
-        """Loop ``run_once`` on the configured cadence until
-        :attr:`stop_event` (or a SIGTERM-driven preemption request)
-        stops it.  Returns the number of completed cycles."""
+        """Loop ``run_once`` until :attr:`stop_event` (or a
+        SIGTERM-driven preemption request) stops it — on the fixed
+        cadence by default, or trigger-driven when a staleness /
+        delta-count threshold is configured (the interval then acts as a
+        backstop ceiling, never a floor).  Returns the number of
+        completed cycles."""
         from predictionio_tpu.resilience.supervision import (
             preemption_requested,
         )
@@ -333,6 +444,10 @@ class RefreshDaemon:
             cycles += 1
             if self.stop_event.is_set() or preemption_requested():
                 break
+            if self._trigger_mode():
+                if self._await_trigger(sleep) is None:
+                    break
+                continue
             elapsed = self._clock() - started
             wait = max(self.config.interval_s - elapsed, 0.0)
             if sleep is not None:

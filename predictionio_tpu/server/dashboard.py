@@ -128,6 +128,23 @@ td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left}}</style></head>
                                "PIO_FLEET_INSTANCES or `pio dashboard "
                                "--fleet URL,URL`"})
             return 200, "application/json", json.dumps(self.fleet.scrape())
+        if path == "/quality.json":
+            # Fleet-merged model-quality view (ISSUE 11): per-instance
+            # /quality.json docs + the union-of-keys merge.
+            if self.fleet is None:
+                return 200, "application/json", json.dumps({
+                    "enabled": False,
+                    "message": "no fleet configured — set "
+                               "PIO_FLEET_INSTANCES or `pio dashboard "
+                               "--fleet URL,URL`"})
+            doc = self.fleet.scrape()
+            return 200, "application/json", json.dumps({
+                "merged": doc["merged"].get("quality"),
+                "instances": [
+                    {"instance": row["instance"], "stale": row["stale"],
+                     "quality": row.get("quality")}
+                    for row in doc["instances"]],
+            })
         if path == "/engine_instances.json":
             rows = [
                 {"id": r.id, "status": r.status,

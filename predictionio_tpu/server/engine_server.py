@@ -37,6 +37,7 @@ from predictionio_tpu.obs import (
     start_runtime_introspection,
 )
 from predictionio_tpu.obs import waterfall as _waterfall
+from predictionio_tpu.obs.quality import SERVE_ID_HEADER, QualityMonitor
 from predictionio_tpu.obs.slo import SLOConfig, SLOEngine
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
@@ -283,6 +284,10 @@ class EngineServer:
         self.slo = SLOEngine(SLOConfig.from_env(),
                              registry=reg,
                              saturation_fn=self.scheduler.saturated)
+        # Model-quality layer (ISSUE 11): sampled prediction stream +
+        # drift detection + shadow-scored canary + feedback join, all
+        # behind the PIO_QUALITY kill switch (off = inert no-op hooks).
+        self.quality = QualityMonitor(registry=reg)
 
     def _load_candidate(self):
         """Storage-read phase of the staged reload (runs under the
@@ -388,9 +393,24 @@ class EngineServer:
                 self._loaded_at = now
                 self._generation += 1
                 gen = self._generation
-                retained = self._previous is not None
+                prev = self._previous
+                retained = prev is not None
             self._gen_gauge.set(gen)
             self._prev_retained.set(1 if retained else 0)
+            # Quality re-anchor (ISSUE 11): the new generation's
+            # scorecard becomes the drift baseline, and — while a
+            # previous generation is retained for rollback — its predict
+            # stack shadow-scores a sampled slice of live queries so the
+            # canary window can judge old-vs-new divergence.  The
+            # closure is dropped on rollback/eviction so the retained
+            # generation's memory is actually freed.
+            shadow_fn = None
+            if retained and self.quality.enabled:
+                def shadow_fn(q, _gen=prev):
+                    return self._shadow_predict(_gen, q)
+            self.quality.on_generation(
+                gen, models, shadow_fn=shadow_fn,
+                prev_generation=prev.number if retained else None)
             self._arm_eviction(gen)
             self._record_reload("ok", instance=instance.id, generation=gen)
             logger.info("Engine server loaded instance %s (generation %d)",
@@ -419,8 +439,13 @@ class EngineServer:
                 self._generation += 1
                 gen = self._generation
                 instance_id = prev.instance.id
+                restored_models = prev.models
             self._gen_gauge.set(gen)
             self._prev_retained.set(1)
+            # Quality: the rollback ends any shadow session (the "new"
+            # generation it was judging is out) and re-anchors drift on
+            # the RESTORED generation's own scorecard.
+            self.quality.on_generation(gen, restored_models)
             # The rolled-from generation now sits in the previous slot;
             # it ages out on the same TTL as any other retained one.
             self._arm_eviction(gen)
@@ -462,6 +487,10 @@ class EngineServer:
             self._previous = None
         self._prev_retained.set(0)
         self._prev_evicted.inc()
+        # The shadow session holds the evicted generation's predict
+        # closure — drop it with the generation, or the eviction frees
+        # nothing.
+        self.quality.end_shadow("previous generation evicted")
         publish_event("model.previous_evicted",
                       generation=expected_generation,
                       evicted_generation=dropped.number)
@@ -503,6 +532,16 @@ class EngineServer:
                 predictions.append(a.predict(m, q))
         with span("predict.serve"):
             return self._result_to_json(serving.serve(q, predictions))
+
+    def _shadow_predict(self, gen: _Generation, q: Any) -> Any:
+        """Score one BOUND query against a retained (non-serving)
+        generation's full predict stack — the shadow-scoring canary's
+        reference answer (ISSUE 11).  Runs on the shadow worker thread,
+        never a handler thread."""
+        q2 = gen.serving.supplement(q)
+        preds = [a.predict(m, q2)
+                 for a, m in zip(gen.algorithms, gen.models)]
+        return self._result_to_json(gen.serving.serve(q2, preds))
 
     def query(self, query_json: Any) -> Any:
         """One predict round-trip (reference §3.2 hot path).
@@ -617,8 +656,15 @@ class EngineServer:
                 return 200, {**self.stats.snapshot(),
                              "batcher": self.scheduler.snapshot(),
                              "slo": self.slo.snapshot(),
+                             "quality": self.quality.summary(),
                              "dataWatermark": wm.isoformat() if wm
                              else None}
+            if path == "/quality.json" and method == "GET":
+                # Model-quality document (ISSUE 11): drift vs the
+                # training scorecard, shadow-canary divergence, online
+                # hit-rate, and the promotion-gate verdict the refresh
+                # daemon polls during the canary window.
+                return 200, self.quality.payload()
             if path == "/traces.json" and method == "GET":
                 # ?request_id= resolves waterfall exemplars to ONE trace;
                 # ?min_ms=/?limit= bound the view (shared helper).
@@ -678,6 +724,14 @@ class EngineServer:
                     # micro-batcher → vectorized dispatch (ISSUE 6; the
                     # lint forbids calling query/query_batch from here).
                     wf = _waterfall.current_waterfall()
+                    # ONE uniform draw per request (ISSUE 11): the
+                    # prediction record stream, shadow sampling, and the
+                    # PIO_REQUEST_LOG_SAMPLE wide-event sampler all
+                    # compare this same u against their own rates.
+                    u = self.quality.draw() if self.quality.enabled \
+                        else None
+                    if wf is not None and u is not None:
+                        wf.sample_u = u
                     try:
                         result = self.scheduler.submit_and_wait(
                             "default", q)
@@ -693,6 +747,22 @@ class EngineServer:
                     # client's budget is spent, so it gets the same 504
                     # the waiter would have raised a tick later.
                     _deadline.check("respond")
+                    # Quality record stream, at the scheduler hand-back
+                    # (the request side of the dispatch boundary): one
+                    # sampled append, attributed to the generation the
+                    # batcher stamped on the dispatch.
+                    sid = self.quality.observe(
+                        q, result,
+                        wf.attr("generation") if wf is not None else None,
+                        u)
+                    if sid is not None and wf is not None:
+                        # Rides the waterfall into the wide event AND to
+                        # the transport hook that echoes it as
+                        # X-PIO-Serve-Id — a client that sends the id
+                        # back on its buy/rate event
+                        # (properties.pioServeId) closes the feedback
+                        # join.
+                        wf.note(serveId=sid)
                     self.stats.record((time.perf_counter() - t0) * 1e3, True)
                     return 200, result
                 except QueueFull as e:
@@ -748,9 +818,18 @@ class EngineServer:
 
             def pio_on_complete(self, method, path, status, ms, body,
                                 params):
-                return server_self.plugins.on_request(
-                    f"{method} {path}", status, ms) \
-                    if server_self.plugins else None
+                extra = dict(server_self.plugins.on_request(
+                    f"{method} {path}", status, ms) or {}) \
+                    if server_self.plugins else {}
+                # Serve-id echo (ISSUE 11): the quality layer noted the
+                # sampled serve on the request's waterfall; surface it
+                # as a response header so the client can echo it on its
+                # feedback event.
+                wf = _waterfall.current_waterfall()
+                sid = wf.attr("serveId") if wf is not None else None
+                if sid:
+                    extra[SERVE_ID_HEADER] = str(sid)
+                return extra or None
 
             def pio_retry_after_s(self):
                 # Breaker-open reload shed carries the breaker's actual
@@ -788,4 +867,5 @@ class EngineServer:
             self._evict_timer.cancel()
             self._evict_timer = None
         self.scheduler.close()
+        self.quality.close()
         self.plugins.stop()

@@ -237,8 +237,21 @@ class EventServer:
         """Advance the per-app ingest high-watermark gauge after events
         LANDED in the store.  First touch of an app seeds the floor from
         the backend's own MAX so a restarted server reports the true
-        store-wide watermark, not just this process's ingest."""
+        store-wide watermark, not just this process's ingest.
+
+        Also the feedback-join hook (ISSUE 11): a landed buy/rate event
+        echoing a served recommendation's id (``properties.pioServeId``)
+        joins back to the served item set → online hit-rate per model
+        generation.  Joining here — not at accept time — means spilled
+        (202) events count only when replay lands them, same contract as
+        the watermark."""
         from predictionio_tpu.data.storage.base import epoch_us
+        from predictionio_tpu.obs.quality import note_feedback_events
+
+        try:
+            note_feedback_events(evs)
+        except Exception:
+            logger.exception("feedback join failed (ingest unaffected)")
 
         newest = None
         for ev in evs:

@@ -39,6 +39,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
+from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
 from predictionio_tpu.retrieval import (
     IVFIndex,
     Retriever,
@@ -296,6 +297,10 @@ class ALSModelWrapper:
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Training-time score-distribution baseline (ISSUE 11): rides the
+    # same atomic-swap contract as ``ivf`` — serving drift is judged
+    # against THIS generation's own baseline.
+    quality: Optional[Scorecard] = None
     # Fold-in context (ISSUE 10), persisted with the generation.
     app_name: Optional[str] = None
     fold_event_names: Sequence[str] = ()
@@ -541,6 +546,8 @@ class ALSAlgorithm(Algorithm):
         )
         itf_host = np.asarray(
             jax.device_get(model.item_factors))[: len(prepared_data.item_index)]
+        uf_host = np.asarray(
+            jax.device_get(model.user_factors))[: len(prepared_data.user_index)]
         return ALSModelWrapper(
             model=model,
             user_index=prepared_data.user_index,
@@ -552,6 +559,11 @@ class ALSAlgorithm(Algorithm):
             # explicit PIO_IVF=on, never auto.
             ivf=build_train_index(itf_host, name="als", seed=cfg.seed,
                                   require_explicit=True),
+            # Quality baseline (ISSUE 11): top-K reconstruction scores
+            # of a seeded user sample against the item factors — the
+            # population serving's itemScores come from.
+            quality=scorecard_from_matrix(uf_host, itf_host,
+                                          seed=cfg.seed or 0, name="als"),
             # Fold-in context (ISSUE 10): where this generation's events
             # live + the solve hyper-parameters it was trained with, so
             # serve-time fold-in solves the SAME normal equation the

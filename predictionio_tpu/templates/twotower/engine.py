@@ -33,6 +33,7 @@ from predictionio_tpu.controller import (
 from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import two_tower as tt_lib
+from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
 from predictionio_tpu.retrieval import (
     IVFIndex,
     Retriever,
@@ -130,6 +131,11 @@ class TwoTowerModelWrapper:
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Training-time score-distribution baseline (ISSUE 11): rides the
+    # same atomic-swap contract as ``ivf`` — serving drift is always
+    # judged against THIS generation's own baseline, fingerprint-pinned
+    # to the corpus it was scored over.
+    quality: Optional[Scorecard] = None
     # Warm-start carry (ISSUE 10): the host-numpy train state + the
     # config it was trained under + the interaction count — what the
     # next refresh needs to CONTINUE training on a delta window instead
@@ -216,6 +222,12 @@ class TwoTowerAlgorithm(Algorithm):
             # generation swap moves both atomically.
             ivf=build_train_index(item_vecs, name="twotower",
                                   seed=cfg.seed),
+            # Quality baseline (ISSUE 11): top-K scores of a seeded user
+            # sample against the full corpus — the same population
+            # serving emits, so serve-time PSI compares like with like.
+            quality=scorecard_from_matrix(user_vecs, item_vecs,
+                                          seed=cfg.seed or 0,
+                                          name="twotower"),
             train_state=tt_lib.state_to_host(state),
             train_cfg=cfg,
             n_examples=int(n_examples))

@@ -1,0 +1,938 @@
+"""Model-quality observability (ISSUE 11): prediction drift,
+shadow-scored canaries, feedback-joined online metrics, and the quality
+promotion gate.
+
+Acceptance spine: a clean server reads healthy (PSI ≈ 0 against its own
+scorecard); a promoted generation with an injected score shift is
+detected (PSI over threshold on BOTH windows), rolled back through the
+existing ``/admin/rollback`` path by the refresh daemon's canary watch,
+and the pre-promotion generation serves throughout with zero non-2xx;
+``PIO_QUALITY=off`` disables every hook; the scorecard rides the model
+wrapper (pickle-atomic with the model, fingerprint-mismatch degrades to
+reporting-only); the ``/quality.json`` fleet merge never silently drops
+a field.  All drift/hysteresis/trigger tests ride injectable clocks —
+zero wall sleeps.
+"""
+
+import datetime as dt
+import json
+import pickle
+import threading
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import DataMap, Event
+from predictionio_tpu.data.storage import AccessKey, App, get_storage
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.obs.quality import (
+    DriftDetector,
+    FeedbackJoiner,
+    QualityConfig,
+    QualityMonitor,
+    Scorecard,
+    ShadowScorer,
+    extract_result_items,
+    generation_of_serve_id,
+    kl_divergence,
+    merge_quality,
+    note_feedback_events,
+    psi,
+    resolve_scorecard,
+    scorecard_from_scores,
+)
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+UTC = dt.timezone.utc
+
+
+# ==========================================================================
+# PSI / scorecard math
+# ==========================================================================
+
+class TestScorecardMath:
+    def test_psi_zero_for_identical_distributions(self):
+        p = [0.25, 0.25, 0.25, 0.25]
+        assert psi(p, p) == pytest.approx(0.0)
+        assert kl_divergence(p, p) == pytest.approx(0.0)
+
+    def test_psi_known_value(self):
+        # hand-computed: Σ (a-e)·ln(a/e)
+        e = [0.5, 0.5]
+        a = [0.8, 0.2]
+        expect = (0.8 - 0.5) * np.log(0.8 / 0.5) \
+            + (0.2 - 0.5) * np.log(0.2 / 0.5)
+        assert psi(e, a) == pytest.approx(float(expect), abs=1e-9)
+        assert psi(e, a) > 0
+
+    def test_psi_smooths_empty_bins(self):
+        assert np.isfinite(psi([0.0, 1.0], [1.0, 0.0]))
+
+    def test_scorecard_quantile_bins_carry_equal_mass(self):
+        rng = np.random.default_rng(0)
+        sc = scorecard_from_scores(rng.normal(0, 1, 4000).tolist(),
+                                   bins=16)
+        assert sc is not None
+        assert sum(sc.probs) == pytest.approx(1.0)
+        # quantile construction: every bin holds ~1/16 of the mass
+        assert max(sc.probs) < 0.2
+        assert sc.n == 4000
+
+    def test_scorecard_degenerate_sample_returns_none(self):
+        assert scorecard_from_scores([]) is None
+        assert scorecard_from_scores([1.0]) is None
+        assert scorecard_from_scores([2.0] * 100) is None
+        assert scorecard_from_scores([np.nan, np.inf, 1.0]) is None
+
+    def test_edges_sit_between_observed_values_ulp_robust(self):
+        # A tiny discrete sample: serving recomputes the same scores
+        # through a different op order, so a value must not sit ON its
+        # own bin edge (a 1-ulp difference would flip bins → fake PSI).
+        vals = [float(v) for v in range(10)]
+        sc = scorecard_from_scores(vals, bins=16)
+        for v in vals:
+            assert v not in sc.edges
+            eps = 1e-9
+            assert sc.bin_index(v - eps) == sc.bin_index(v + eps)
+
+
+# ==========================================================================
+# Drift detection (fake clock, zero wall sleeps)
+# ==========================================================================
+
+def _cfg(**kw) -> QualityConfig:
+    base = dict(sample=1.0, reservoir=600, fast_window=100,
+                min_samples=50, psi_threshold=0.25, recovery_s=30.0)
+    base.update(kw)
+    return QualityConfig(**base)
+
+
+def _baseline(seed=0, n=2000):
+    rng = np.random.default_rng(seed)
+    return scorecard_from_scores(rng.normal(0, 1, n).tolist())
+
+
+class TestDriftDetector:
+    def test_clean_stream_never_trips(self):
+        t = [0.0]
+        det = DriftDetector(_cfg(), _baseline(), clock=lambda: t[0])
+        rng = np.random.default_rng(1)
+        for v in rng.normal(0, 1, 800):
+            det.add(float(v))
+        s = det.tick(force=True)
+        assert not s["tripped"]
+        assert s["psi"]["fast"] < 0.25 and s["psi"]["slow"] < 0.25
+
+    def test_injected_shift_trips_on_both_windows(self):
+        t = [0.0]
+        det = DriftDetector(_cfg(), _baseline(), clock=lambda: t[0])
+        rng = np.random.default_rng(2)
+        for v in rng.normal(2.5, 1, 700):
+            det.add(float(v))
+        s = det.tick(force=True)
+        assert s["tripped"]
+        assert s["psi"]["fast"] >= 0.25 and s["psi"]["slow"] >= 0.25
+
+    def test_fast_burst_alone_does_not_trip(self):
+        # The slow window (generation reservoir) still holds mostly
+        # clean mass — one burst must not read as a generation shift.
+        t = [0.0]
+        det = DriftDetector(_cfg(reservoir=4000), _baseline(),
+                            clock=lambda: t[0])
+        rng = np.random.default_rng(3)
+        for v in rng.normal(0, 1, 3000):
+            det.add(float(v))
+        for v in rng.normal(3.0, 1, 120):   # fills the fast window only
+            det.add(float(v))
+        s = det.tick(force=True)
+        assert s["psi"]["fast"] >= 0.25
+        assert s["psi"]["slow"] < 0.25
+        assert not s["tripped"]
+
+    def test_hysteresis_clears_only_after_recovery_dwell(self):
+        t = [0.0]
+        det = DriftDetector(_cfg(recovery_s=30.0), _baseline(),
+                            clock=lambda: t[0])
+        rng = np.random.default_rng(4)
+        for v in rng.normal(2.5, 1, 700):
+            det.add(float(v))
+        assert det.tick(force=True)["tripped"]
+        # back to clean: both windows drain (reservoir mostly replaced)
+        for v in rng.normal(0, 1, 6000):
+            det.add(float(v))
+        t[0] += 2.0
+        s = det.tick(force=True)
+        assert s["psi"]["fast"] < 0.25
+        assert s["tripped"], "must stay tripped through the dwell"
+        t[0] += 10.0
+        assert det.tick(force=True)["tripped"]
+        t[0] += 31.0
+        assert not det.tick(force=True)["tripped"]
+        # a flap inside the dwell resets it
+        det2 = DriftDetector(_cfg(recovery_s=30.0), _baseline(),
+                             clock=lambda: t[0])
+        for v in rng.normal(2.5, 1, 700):
+            det2.add(float(v))
+        assert det2.tick(force=True)["tripped"]
+        for v in rng.normal(0, 1, 6000):
+            det2.add(float(v))
+        t[0] += 10.0
+        det2.tick(force=True)          # dwell running
+        for v in rng.normal(2.5, 1, 700):
+            det2.add(float(v))          # flap back over threshold
+        t[0] += 1.0
+        assert det2.tick(force=True)["tripped"]
+        for v in rng.normal(0, 1, 6000):
+            det2.add(float(v))
+        t[0] += 20.0                    # 20s < 30s since the flap
+        assert det2.tick(force=True)["tripped"]
+
+    def test_cold_app_pass_through_never_trips(self):
+        t = [0.0]
+        det = DriftDetector(_cfg(min_samples=100), _baseline(),
+                            clock=lambda: t[0])
+        rng = np.random.default_rng(5)
+        for v in rng.normal(5.0, 1, 60):   # wildly shifted but few
+            det.add(float(v))
+        s = det.tick(force=True)
+        assert s["insufficient"]
+        assert not s["tripped"]
+
+    def test_missing_scorecard_is_reporting_only(self):
+        det = DriftDetector(_cfg(), None, clock=lambda: 0.0)
+        det.add(1.0)
+        s = det.tick(force=True)
+        assert s["reportingOnly"]
+        assert s["reason"] == "no_scorecard"
+        assert not s["tripped"]
+
+
+# ==========================================================================
+# Shadow scoring
+# ==========================================================================
+
+class TestShadowScorer:
+    @pytest.fixture(autouse=True)
+    def _iso(self, pio_home):
+        # fresh registry per test: the scorer's counters/gauges must not
+        # leak across cases
+        yield
+
+    def _scorer(self, fn, **kw):
+        s = ShadowScorer(_cfg(min_samples=3, **kw))
+        # arm a session WITHOUT the worker thread: tests drive
+        # drain_once() synchronously
+        s._fn = fn
+        s._generation = 2
+        s._prev_generation = 1
+        return s
+
+    def test_identical_results_overlap_one(self):
+        items = [("a", 1.0), ("b", 0.5)]
+        s = self._scorer(lambda q: {"itemScores": [
+            {"item": "a", "score": 1.0}, {"item": "b", "score": 0.5}]})
+        for _ in range(4):
+            s.submit({"user": "u"}, items, generation=2)
+            assert s.drain_once() == 1
+        snap = s.snapshot()
+        assert snap["overlapMean"] == 1.0
+        assert not snap["divergent"]
+
+    def test_disjoint_results_divergent_after_min_samples(self):
+        s = self._scorer(lambda q: {"itemScores": [
+            {"item": "x", "score": 2.0}]})
+        for i in range(2):
+            s.submit({"u": i}, [("a", 1.0)], generation=2)
+            s.drain_once()
+        assert s.snapshot()["insufficient"]      # 2 < min_samples=3
+        assert not s.snapshot()["divergent"]      # pass-through
+        s.submit({"u": 9}, [("a", 1.0)], generation=2)
+        s.drain_once()
+        snap = s.snapshot()
+        assert snap["overlapMean"] == 0.0
+        assert snap["divergent"]
+
+    def test_score_delta_recorded_for_shared_items(self):
+        reg = get_registry()
+        s = self._scorer(lambda q: {"itemScores": [
+            {"item": "a", "score": 1.0}]})
+        s.submit({}, [("a", 1.5)], generation=2)
+        s.drain_once()
+        h = reg.get("pio_quality_shadow_delta")
+        assert h.count() == 1
+        # |1.5-1.0|/1.0 = 0.5
+        assert h.sum() == pytest.approx(0.5, rel=1e-4)
+
+    def test_queue_bound_drops_never_blocks(self):
+        s = self._scorer(lambda q: {"itemScores": []},
+                         shadow_queue=2)
+        for i in range(5):
+            s.submit({"u": i}, [("a", 1.0)], generation=2)
+        reg = get_registry()
+        assert reg.get("pio_quality_shadow_total") \
+            .value(result="dropped") == 3
+
+    def test_stale_generation_submits_ignored(self):
+        s = self._scorer(lambda q: {"itemScores": []})
+        s.submit({}, [("a", 1.0)], generation=99)   # not the session's
+        assert s.drain_once() == 0
+
+    def test_stop_drops_closure_and_queue(self):
+        s = self._scorer(lambda q: {"itemScores": []})
+        s.submit({}, [("a", 1.0)], generation=2)
+        s.stop("rollback")
+        assert not s.active()
+        assert s.drain_once() == 0
+
+
+# ==========================================================================
+# Feedback join
+# ==========================================================================
+
+class TestFeedbackJoiner:
+    def test_hit_miss_unmatched_expired(self, pio_home):
+        t = [0.0]
+        j = FeedbackJoiner(ttl_s=100.0, clock=lambda: t[0])
+        j.note_serve("g3-aaa", 3, ["i1", "i2"])
+        assert j.feedback("g3-aaa", "i1") == "hit"
+        assert j.feedback("g3-aaa", "i7") == "miss"
+        assert j.feedback("g9-zzz", "i1") == "unmatched"
+        t[0] += 101.0
+        assert j.feedback("g3-aaa", "i1") == "expired"
+        snap = j.snapshot()
+        assert snap["generations"]["3"] == {
+            "hits": 1, "misses": 1, "attributedOnly": 0, "hitRate": 0.5}
+        assert snap["generations"]["9"]["attributedOnly"] == 1
+
+    def test_ttl_and_capacity_eviction(self, pio_home):
+        t = [0.0]
+        j = FeedbackJoiner(ttl_s=10.0, max_records=3, clock=lambda: t[0])
+        for i in range(5):
+            j.note_serve(f"g1-{i}", 1, ["x"])
+        assert j.snapshot()["tracked"] == 3
+        t[0] += 11.0
+        j.note_serve("g1-new", 1, ["x"])
+        assert j.snapshot()["tracked"] == 1   # the TTL swept the rest
+
+    def test_generation_parse(self):
+        assert generation_of_serve_id("g12-abcd") == 12
+        assert generation_of_serve_id("nope") is None
+        assert generation_of_serve_id("gxyz-1") is None
+
+    def test_event_ingest_hook_joins_echoed_serves(self, pio_home,
+                                                   monkeypatch):
+        monkeypatch.setenv("PIO_QUALITY_SAMPLE", "1.0")
+        from predictionio_tpu.obs.quality import feedback_joiner
+
+        j = feedback_joiner()
+        j.note_serve("g2-echo", 2, ["i5"])
+        ev = Event(event="buy", entity_type="user", entity_id="u1",
+                   target_entity_type="item", target_entity_id="i5",
+                   properties=DataMap({"pioServeId": "g2-echo"}))
+        note_feedback_events([ev])
+        reg = get_registry()
+        assert reg.get("pio_quality_feedback_total") \
+            .value(result="hit") == 1
+        # non-feedback event names are ignored even with an echo
+        ev2 = Event(event="view", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i5",
+                    properties=DataMap({"pioServeId": "g2-echo"}))
+        note_feedback_events([ev2])
+        assert reg.get("pio_quality_feedback_total") \
+            .value(result="hit") == 1
+
+    def test_kill_switch_disables_hook(self, pio_home, monkeypatch):
+        monkeypatch.setenv("PIO_QUALITY", "off")
+        ev = Event(event="buy", entity_type="user", entity_id="u1",
+                   target_entity_type="item", target_entity_id="i5",
+                   properties=DataMap({"pioServeId": "g2-x"}))
+        note_feedback_events([ev])
+        assert get_registry().get("pio_quality_feedback_total") is None
+
+
+# ==========================================================================
+# Monitor facade + kill switch + result extraction
+# ==========================================================================
+
+class TestQualityMonitor:
+    def test_extract_result_items(self):
+        assert extract_result_items(
+            {"itemScores": [{"item": "a", "score": 1.5}]}) == [("a", 1.5)]
+        assert extract_result_items({"itemScores": []}) == []
+        assert extract_result_items({"score": 0.7}) == [(None, 0.7)]
+        assert extract_result_items({"label": "x"}) is None
+        assert extract_result_items("nope") is None
+
+    def test_observe_samples_and_issues_serve_id(self, pio_home):
+        m = QualityMonitor(_cfg(sample=0.5, min_samples=2),
+                           clock=lambda: 0.0)
+        m.on_generation(4, [])
+        sid = m.observe({}, {"itemScores": [{"item": "a", "score": 1.0}]},
+                        4, u=0.0)
+        assert sid is not None and sid.startswith("g4-")
+        # a draw at/above the rate is not sampled: no serve id, no append
+        assert m.observe({}, {"itemScores": []}, 4, u=0.999) is None
+        reg = get_registry()
+        assert reg.get("pio_quality_sampled_total").value() == 1
+        assert reg.get("pio_predict_score").count() == 1
+
+    def test_empty_and_diversity_accounting(self, pio_home):
+        m = QualityMonitor(_cfg(min_samples=2), clock=lambda: 0.0)
+        m.on_generation(1, [])
+        m.observe({}, {"itemScores": []}, 1, u=0.0)
+        for _ in range(4):
+            m.observe({}, {"itemScores": [
+                {"item": "hot", "score": 1.0},
+                {"item": "x", "score": 0.5}]}, 1, u=0.0)
+        doc = m.payload()
+        assert doc["sampling"]["emptyTotal"] == 1
+        # 8 slots, 2 distinct, "hot" takes half
+        assert doc["diversity"]["candidateDiversity"] == pytest.approx(
+            2 / 8)
+        assert doc["diversity"]["topItemShare"] == pytest.approx(0.5)
+
+    def test_kill_switch_disables_every_hook(self, pio_home, monkeypatch):
+        monkeypatch.setenv("PIO_QUALITY", "off")
+        m = QualityMonitor()
+        assert not m.enabled
+        m.on_generation(1, [])               # no-ops, no instruments
+        assert m.observe({}, {"itemScores": [
+            {"item": "a", "score": 1.0}]}, 1, u=0.0) is None
+        assert m.payload() == {"enabled": False}
+        assert m.summary() == {"enabled": False}
+        m.close()
+        reg = get_registry()
+        for name in ("pio_quality_sampled_total", "pio_predict_score",
+                     "pio_quality_drift", "pio_quality_shadow_total"):
+            assert reg.get(name) is None, name
+
+    def test_gate_respects_pass_through_and_gate_switch(self, pio_home):
+        sc = _baseline()
+        m = QualityMonitor(_cfg(min_samples=50), clock=lambda: 0.0)
+        wrapper = type("W", (), {"quality": sc})()
+        m.on_generation(1, [wrapper])
+        rng = np.random.default_rng(0)
+        # massive shift but BELOW min_samples → pass-through
+        for v in rng.normal(4.0, 1, 30):
+            m.observe({}, {"itemScores": [
+                {"item": "a", "score": float(v)}]}, 1, u=0.0)
+        doc = m.payload()
+        assert doc["verdict"] == "insufficient"
+        assert not doc["gate"]["rollback"]
+        # past the floor → degraded + rollback verdict
+        for v in rng.normal(4.0, 1, 600):
+            m.observe({}, {"itemScores": [
+                {"item": "a", "score": float(v)}]}, 1, u=0.0)
+        m._detector.tick(force=True)
+        doc = m.payload()
+        assert doc["verdict"] == "degraded"
+        assert doc["gate"]["rollback"] and "drift" in doc["gate"]["reasons"]
+        # PIO_QUALITY_GATE=off reports but never gates
+        m2 = QualityMonitor(_cfg(min_samples=50, gate=False),
+                            clock=lambda: 0.0)
+        m2.on_generation(1, [wrapper])
+        for v in rng.normal(4.0, 1, 700):
+            m2.observe({}, {"itemScores": [
+                {"item": "a", "score": float(v)}]}, 1, u=0.0)
+        m2._detector.tick(force=True)
+        doc2 = m2.payload()
+        assert doc2["verdict"] == "degraded"
+        assert not doc2["gate"]["rollback"]
+
+
+# ==========================================================================
+# Scorecard rides the wrapper (atomic swap + mismatch tripwire)
+# ==========================================================================
+
+TT_VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.twotower:engine",
+    "datasource": {"params": {"appName": "app"}},
+    "algorithms": [{"name": "twotower",
+                    "params": {"embedDim": 8, "hiddenDims": [16],
+                               "outDim": 8, "epochs": 2, "batchSize": 32,
+                               "seed": 1}}],
+}
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _mk_app(ctx, name="app"):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name=name))
+    storage.get_events().init(app_id)
+    return app_id
+
+
+def _view(u, i, when=None):
+    kw = {"event_time": when} if when is not None else {}
+    return Event(event="view", entity_type="user", entity_id=f"u{u}",
+                 target_entity_type="item", target_entity_id=f"i{i}", **kw)
+
+
+def _seed_views(ctx, app_id, n_users=10, n_items=6):
+    evs = [_view(u, i) for u in range(n_users) for i in range(n_items)
+           if i % 2 == u % 2]
+    ctx.storage.get_events().insert_batch(evs, app_id)
+
+
+def _tt():
+    from predictionio_tpu.templates.twotower import engine
+
+    return engine(), EngineVariant.from_dict(TT_VARIANT)
+
+
+class TestScorecardOnWrapper:
+    def test_train_builds_scorecard_and_pickle_keeps_it(self, ctx):
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        iid = run_train(eng, variant, ctx)
+        wrapper = load_models(
+            eng, ctx.storage.get_engine_instances().get(iid), ctx)[0]
+        sc = wrapper.quality
+        assert isinstance(sc, Scorecard) and sc.n > 0
+        assert sc.fingerprint
+        clone = pickle.loads(pickle.dumps(wrapper))
+        assert clone.quality == sc        # model+scorecard = ONE artifact
+        got, reason = resolve_scorecard([clone])
+        assert got == sc and reason is None
+
+    def test_fingerprint_mismatch_degrades_to_reporting_only(self, ctx):
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        iid = run_train(eng, variant, ctx)
+        wrapper = load_models(
+            eng, ctx.storage.get_engine_instances().get(iid), ctx)[0]
+        wrapper.item_vecs = np.asarray(wrapper.item_vecs) * 2.0
+        got, reason = resolve_scorecard([wrapper])
+        assert got is None and reason == "fingerprint_mismatch"
+        # and the monitor serves it as reporting-only — never a gate
+        m = QualityMonitor(_cfg(min_samples=1), clock=lambda: 0.0)
+        m.on_generation(1, [wrapper])
+        for _ in range(10):
+            m.observe({}, {"itemScores": [
+                {"item": "a", "score": 99.0}]}, 1, u=0.0)
+        doc = m.payload()
+        assert doc["verdict"] == "reporting_only"
+        assert not doc["gate"]["rollback"]
+
+    def test_old_pickle_without_scorecard_reports_no_scorecard(self):
+        w = type("W", (), {})()
+        got, reason = resolve_scorecard([w])
+        assert got is None and reason == "no_scorecard"
+
+
+# ==========================================================================
+# /quality.json schema stability under the fleet merge (tier-1)
+# ==========================================================================
+
+def _doc_keys(doc, prefix=""):
+    out = set()
+    for k, v in doc.items():
+        out.add(prefix + k)
+        if isinstance(v, dict):
+            out |= _doc_keys(v, prefix + k + ".")
+    return out
+
+
+class TestQualityFleetMerge:
+    def _doc(self, gen=1, hits=2, misses=1):
+        m = QualityMonitor(_cfg(min_samples=5), clock=lambda: 0.0)
+        sc = _baseline()
+        wrapper = type("W", (), {"quality": sc})()
+        m.on_generation(gen, [wrapper])
+        rng = np.random.default_rng(gen)
+        for v in rng.normal(0, 1, 20):
+            sid = m.observe({}, {"itemScores": [
+                {"item": "a", "score": float(v)}]}, gen, u=0.0)
+        for _ in range(hits):
+            m.joiner.feedback(sid, "a")
+        for _ in range(misses):
+            m.joiner.feedback(sid, "zzz")
+        return m.payload()
+
+    def test_merge_never_silently_drops_a_field(self, pio_home):
+        d1 = self._doc(gen=1)
+        d2 = self._doc(gen=2)
+        merged = merge_quality([d1, d2])
+        missing = (_doc_keys(d1) | _doc_keys(d2)) - _doc_keys(merged)
+        assert not missing, f"fleet merge dropped fields: {missing}"
+
+    def test_merge_semantics(self, pio_home):
+        d1, d2 = self._doc(gen=1), self._doc(gen=2)
+        merged = merge_quality([d1, d2])
+        assert merged["instances"] == 2
+        assert merged["sampling"]["sampledTotal"] == \
+            d1["sampling"]["sampledTotal"] + d2["sampling"]["sampledTotal"]
+        # drift magnitudes take the worst, not the sum
+        assert merged["drift"]["psi"]["fast"] == max(
+            d1["drift"]["psi"]["fast"], d2["drift"]["psi"]["fast"])
+        # counts sum per generation, ratios recompute from summed parts
+        fb = {"enabled": True, "feedback": {"generations": {
+            "1": {"hits": 2, "misses": 1, "hitRate": 0.6667}}}}
+        g = merge_quality([fb, json.loads(json.dumps(fb))]) \
+            ["feedback"]["generations"]["1"]
+        assert g["hits"] == 4 and g["misses"] == 2
+        assert g["hitRate"] == pytest.approx(4 / 6, abs=1e-3)
+        # verdict worst-of
+        assert merge_quality(
+            [{"enabled": True, "verdict": "healthy"},
+             {"enabled": True, "verdict": "degraded"}])["verdict"] \
+            == "degraded"
+        # all-disabled merges to disabled
+        assert merge_quality([{"enabled": False}]) == {
+            "enabled": False, "instances": 1}
+
+    def test_fleet_aggregator_carries_quality(self, pio_home):
+        from predictionio_tpu.obs.fleet import FleetAggregator
+
+        doc = self._doc(gen=3)
+
+        def fetch(url):
+            if url.endswith("/metrics"):
+                return "# TYPE pio_q_x counter\npio_q_x 1\n"
+            if url.endswith("/quality.json"):
+                return json.dumps(doc)
+            raise OSError("nope")
+
+        agg = FleetAggregator(["http://a:1", "http://b:2"], fetch=fetch)
+        agg.scrape_once()
+        payload = agg.payload()
+        assert payload["instances"][0]["quality"]["generation"] == 3
+        merged = payload["merged"]["quality"]
+        assert merged["enabled"] and merged["instances"] == 2
+        assert not (_doc_keys(doc) - _doc_keys(merged))
+
+    def test_lint_rule4_quality_metrics_only_in_quality_module(self):
+        import tools.lint_metrics as lint
+
+        bad = ("import x\n"
+               "reg.counter('pio_quality_rogue_total', 'h', ())\n")
+        v = lint.check_source(bad, "predictionio_tpu/server/foo.py", {})
+        assert any("rule 4" in s for s in v)
+        ok = lint.check_source(
+            bad, "predictionio_tpu/obs/quality.py", {})
+        assert not any("rule 4" in s for s in ok)
+        # and the real tree passes wholesale
+        assert lint.check() == []
+
+
+# ==========================================================================
+# Shared sampling decision (PIO_REQUEST_LOG_SAMPLE)
+# ==========================================================================
+
+class TestRequestLogSampling:
+    def _finalize(self, u):
+        from predictionio_tpu.obs.waterfall import Waterfall
+
+        wf = Waterfall()
+        wf.stamp("bind", 1.0)
+        wf.sample_u = u
+        return wf.finalize(trace_id="t", status=200, total_ms=2.0)
+
+    def test_sample_rate_gates_the_wide_event(self, pio_home,
+                                              monkeypatch, tmp_path):
+        log = tmp_path / "req.jsonl"
+        monkeypatch.setenv("PIO_REQUEST_LOG", str(log))
+        monkeypatch.setenv("PIO_REQUEST_LOG_SAMPLE", "0.5")
+        self._finalize(u=0.4)     # under the rate → logged
+        self._finalize(u=0.9)     # over → skipped
+        lines = log.read_text().strip().splitlines()
+        assert len(lines) == 1
+
+    def test_default_rate_logs_everything(self, pio_home, monkeypatch,
+                                          tmp_path):
+        log = tmp_path / "req.jsonl"
+        monkeypatch.setenv("PIO_REQUEST_LOG", str(log))
+        monkeypatch.delenv("PIO_REQUEST_LOG_SAMPLE", raising=False)
+        self._finalize(u=0.99999)
+        assert len(log.read_text().strip().splitlines()) == 1
+
+    def test_rate_zero_disables(self, pio_home, monkeypatch, tmp_path):
+        log = tmp_path / "req.jsonl"
+        monkeypatch.setenv("PIO_REQUEST_LOG", str(log))
+        monkeypatch.setenv("PIO_REQUEST_LOG_SAMPLE", "0")
+        self._finalize(u=0.0)
+        assert not log.exists() or not log.read_text().strip()
+
+
+# ==========================================================================
+# Refresh-daemon trigger mode (fake clock, zero wall sleeps)
+# ==========================================================================
+
+class TestRefreshTriggerMode:
+    def _daemon(self, ctx, clock, **cfg_kw):
+        from predictionio_tpu.refresh import RefreshConfig
+        from predictionio_tpu.refresh.daemon import RefreshDaemon
+
+        eng, variant = _tt()
+        cfg = RefreshConfig(interval_s=300.0, trigger_poll_s=1.0,
+                            **cfg_kw)
+        return RefreshDaemon(eng, variant, ctx, config=cfg,
+                             clock=clock)
+
+    def test_staleness_threshold_fires(self, ctx):
+        app_id = _mk_app(ctx)
+        now = dt.datetime.now(UTC)
+        ctx.storage.get_events().insert(_view(0, 1, when=now), app_id)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0], trigger_staleness_s=30.0)
+        d._served_wm = now - dt.timedelta(seconds=100)
+        fire, reason = d._trigger_ready(cycle_started=0.0)
+        assert fire and reason == "staleness"
+        # staleness gauge updated at poll cadence
+        assert get_registry().get("pio_refresh_staleness_s").value() \
+            == pytest.approx(100.0, abs=2.0)
+
+    def test_staleness_under_threshold_does_not_fire(self, ctx):
+        app_id = _mk_app(ctx)
+        now = dt.datetime.now(UTC)
+        ctx.storage.get_events().insert(_view(0, 1, when=now), app_id)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0], trigger_staleness_s=30.0)
+        d._served_wm = now - dt.timedelta(seconds=5)
+        fire, reason = d._trigger_ready(cycle_started=0.0)
+        assert not fire
+
+    def test_delta_count_threshold_fires(self, ctx):
+        app_id = _mk_app(ctx)
+        now = dt.datetime.now(UTC)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0], trigger_delta_count=5)
+        d._served_wm = now - dt.timedelta(seconds=60)
+        for i in range(4):
+            ctx.storage.get_events().insert(
+                _view(i, 1, when=now - dt.timedelta(seconds=30)), app_id)
+        fire, _ = d._trigger_ready(cycle_started=0.0)
+        assert not fire                       # 4 < 5
+        ctx.storage.get_events().insert(
+            _view(9, 1, when=now - dt.timedelta(seconds=30)), app_id)
+        fire, reason = d._trigger_ready(cycle_started=0.0)
+        assert fire and reason == "delta_count"
+
+    def test_interval_backstop_fires_without_events(self, ctx):
+        _mk_app(ctx)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0], trigger_staleness_s=1e9)
+        fire, _ = d._trigger_ready(cycle_started=0.0)
+        assert not fire
+        t[0] = 301.0
+        fire, reason = d._trigger_ready(cycle_started=0.0)
+        assert fire and reason == "interval"
+
+    def test_follow_trigger_loop_zero_wall_sleeps(self, ctx,
+                                                  monkeypatch):
+        app_id = _mk_app(ctx)
+        now = dt.datetime.now(UTC)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0], trigger_delta_count=3)
+        cycles = []
+
+        def fake_run_once():
+            cycles.append(t[0])
+            d._served_wm = now  # cycle consumed the backlog
+            if len(cycles) == 1:
+                # new delta lands mid-wait: the second cycle must fire
+                # on the trigger, not the 300s cadence
+                ctx.storage.get_events().insert_batch(
+                    [_view(i, 1, when=now + dt.timedelta(seconds=1))
+                     for i in range(3)], app_id)
+            else:
+                d.stop()
+            return {}
+
+        monkeypatch.setattr(d, "run_once", fake_run_once)
+
+        def fake_sleep(s):
+            t[0] += s
+
+        n = d.follow(sleep=fake_sleep)
+        assert n == 2
+        # second cycle fired within poll ticks, far before the cadence
+        assert cycles[1] - cycles[0] < 10.0
+        assert get_registry().get("pio_refresh_triggers_total") \
+            .value(reason="delta_count") == 1
+
+    def test_fixed_cadence_unchanged_without_triggers(self, ctx,
+                                                      monkeypatch):
+        _mk_app(ctx)
+        t = [0.0]
+        d = self._daemon(ctx, lambda: t[0])
+        assert not d._trigger_mode()
+        calls = []
+
+        def fake_run_once():
+            calls.append(t[0])
+            if len(calls) == 2:
+                d.stop()
+            return {}
+
+        monkeypatch.setattr(d, "run_once", fake_run_once)
+        d.follow(sleep=lambda s: t.__setitem__(0, t[0] + s))
+        assert len(calls) == 2
+        assert calls[1] - calls[0] == pytest.approx(300.0)
+
+
+# ==========================================================================
+# Live e2e: the acceptance spine
+# ==========================================================================
+
+def _http(base, method, path, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = Request(base + path, data=data, method=method,
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=15) as resp:
+        return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+
+
+class TestQualityGateE2E:
+    """A promoted generation with an injected score shift is detected
+    (PSI over threshold on both windows), rolled back via the existing
+    /admin/rollback path, and the pre-promotion generation serves
+    throughout — zero non-2xx during the episode."""
+
+    def test_clean_server_reads_healthy_and_echoes_serve_id(
+            self, ctx, monkeypatch):
+        monkeypatch.setenv("PIO_QUALITY_SAMPLE", "1.0")
+        monkeypatch.setenv("PIO_QUALITY_MIN_SAMPLES", "25")
+        monkeypatch.setenv("PIO_QUALITY_FAST_WINDOW", "48")
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        run_train(eng, variant, ctx)
+        from predictionio_tpu.server import EngineServer, EventServer
+
+        srv = EngineServer(eng, variant, ctx.storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            sid = None
+            for k in range(60):
+                st, body, headers = _http(base, "POST", "/queries.json",
+                                          {"user": f"u{k % 10}",
+                                           "num": 3})
+                assert st == 200
+                sid = headers.get("X-PIO-Serve-Id") or sid
+                if sid and k == 0:
+                    assert sid.startswith("g1-")
+            assert sid is not None
+            st, doc, _ = _http(base, "GET", "/quality.json")
+            assert st == 200
+            assert doc["verdict"] == "healthy"
+            assert doc["drift"]["psi"]["fast"] < 0.25
+            assert doc["drift"]["psi"]["slow"] < 0.25
+            assert not doc["gate"]["rollback"]
+            # feedback round-trip over the LIVE event server
+            key = ctx.storage.get_access_keys().insert(
+                AccessKey(key="", app_id=app_id))
+            evsrv = EventServer(storage=ctx.storage, host="127.0.0.1",
+                                port=0)
+            evsrv.start()
+            try:
+                served_item = _http(base, "POST", "/queries.json",
+                                    {"user": "u1", "num": 3}
+                                    )[1]["itemScores"][0]["item"]
+                st, _, _ = _http(
+                    f"http://127.0.0.1:{evsrv.port}", "POST",
+                    f"/events.json?accessKey={key}",
+                    {"event": "buy", "entityType": "user",
+                     "entityId": "u1", "targetEntityType": "item",
+                     "targetEntityId": served_item,
+                     "properties": {"pioServeId": sid}})
+                assert st == 201
+                st, doc, _ = _http(base, "GET", "/quality.json")
+                gens = doc["feedback"]["generations"]
+                assert "1" in gens
+                assert gens["1"]["hits"] + gens["1"]["misses"] >= 1
+            finally:
+                evsrv.stop()
+            # stats embed + pio status parser see the same series
+            st, stats, _ = _http(base, "GET", "/stats.json")
+            assert stats["quality"]["verdict"] == "healthy"
+        finally:
+            srv.stop()
+
+    def test_score_shifted_canary_is_auto_rolled_back(self, ctx,
+                                                      monkeypatch):
+        monkeypatch.setenv("PIO_QUALITY_SAMPLE", "1.0")
+        monkeypatch.setenv("PIO_QUALITY_MIN_SAMPLES", "25")
+        monkeypatch.setenv("PIO_QUALITY_FAST_WINDOW", "48")
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        run_train(eng, variant, ctx)
+        from predictionio_tpu.refresh import RefreshConfig
+        from predictionio_tpu.refresh.daemon import (
+            HttpPromoter,
+            RefreshDaemon,
+        )
+        from predictionio_tpu.server import EngineServer
+        from predictionio_tpu.server import engine_server as es_mod
+
+        srv = EngineServer(eng, variant, ctx.storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            gen1_instance = srv._instance.id
+            ctx.storage.get_events().insert(_view(0, 1), app_id)
+            # Poison the SERVER's candidate load with a user-side scale:
+            # scores shift 4× (drift) while the ranking — and the
+            # item-corpus fingerprint the scorecard is pinned to — stay
+            # intact, so ONLY the drift detector can catch it.
+            real_load = es_mod.load_models
+
+            def shifted(engine_, instance, c=None):
+                models = real_load(engine_, instance, c)
+                models[0].user_vecs = np.asarray(
+                    models[0].user_vecs) * 4.0
+                return models
+
+            monkeypatch.setattr(es_mod, "load_models", shifted)
+            stop = threading.Event()
+            outcome = {"non200": 0, "n": 0}
+
+            def drive():
+                k = 0
+                while not stop.is_set():
+                    st, _, _ = _http(base, "POST", "/queries.json",
+                                     {"user": f"u{k % 10}", "num": 3})
+                    if st != 200:
+                        outcome["non200"] += 1
+                    outcome["n"] += 1
+                    k += 1
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            promoter = HttpPromoter(base, canary_window_s=60.0,
+                                    canary_poll_s=0.2)
+            d = RefreshDaemon(
+                eng, variant, ctx,
+                config=RefreshConfig(interval_s=0.01,
+                                     eval_tolerance=10.0),
+                promoter=promoter)
+            out = d.run_once()
+            stop.set()
+            t.join(5)
+            assert out["promotion"] == "rolled_back"
+            # the pre-promotion generation serves again (and served
+            # throughout: zero non-2xx during the whole episode)
+            assert srv._instance.id == gen1_instance
+            assert outcome["non200"] == 0 and outcome["n"] > 0
+            st, body, _ = _http(base, "POST", "/queries.json",
+                                {"user": "u1", "num": 3})
+            assert st == 200 and body["itemScores"]
+            reg = get_registry()
+            assert reg.get("pio_refresh_promotions_total") \
+                .value(result="rolled_back") == 1
+            assert reg.get("pio_quality_drift_tripped") is not None
+        finally:
+            srv.stop()

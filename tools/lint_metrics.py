@@ -23,6 +23,12 @@ This lint locks those invariants in (tier-1 test runs it in CI):
    numbers, or a reference to a MODULE-LEVEL UPPERCASE constant.  A
    bucket list computed at runtime could differ between instances and
    silently corrupt the fleet's per-``le`` bucket addition.
+4. (ISSUE 11) Model-quality metric families — the ``pio_quality_`` and
+   ``pio_predict_`` prefixes — may be REGISTERED only in
+   ``obs/quality.py``: the ``/quality.json`` fleet merge derives its
+   schema from that one module, so a quality series minted elsewhere
+   would fork the schema the merge (and the schema-stability test)
+   relies on.
 
 Usage: ``python tools/lint_metrics.py [root]`` — prints violations and
 exits non-zero when any exist.
@@ -128,6 +134,13 @@ def check_source(source: str, filename: str,
             violations.append(
                 f"{where}: metric {name!r} missing the pio_ prefix "
                 f"(naming convention: pio_<subsystem>_<what>_<unit>)")
+        if name.startswith(("pio_quality_", "pio_predict_")) \
+                and not filename.replace("\\", "/").endswith(
+                    "obs/quality.py"):
+            violations.append(
+                f"{where}: quality metric {name!r} registered outside "
+                f"obs/quality.py — the /quality.json fleet-merge schema "
+                f"is owned by that one module (rule 4)")
         labels = _literal_labelnames(labels_node)
         if labels is None:
             violations.append(

@@ -572,6 +572,18 @@ def cmd_train(args) -> int:
         # Opt-in: wider (concatenated) optimizer steps once fusion depth
         # caps out — a semantics change, so never on by default.
         os.environ["PIO_BATCH_AUTOSCALE"] = "on"
+    if getattr(args, "pq", None):
+        # Quantized-corpus build policy (retrieval/pq.py): templates
+        # read PIO_PQ at train time when deciding whether to serialize
+        # residual codes next to the IVF index.
+        text = str(args.pq).strip().lower()
+        if text not in ("auto", "on", "off"):
+            _die(f"--pq {args.pq!r} must be auto|on|off.")
+        os.environ["PIO_PQ"] = text
+    if getattr(args, "pq_m", 0):
+        if args.pq_m < 1:
+            _die("--pq-m must be a positive integer (subspace count).")
+        os.environ["PIO_PQ_M"] = str(args.pq_m)
     variant_path = Path(args.engine_json)
     if not variant_path.exists():
         _die(f"{variant_path} not found (expected an engine.json).")
@@ -1357,6 +1369,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "once fusion depth caps out — fewer, wider "
                         "optimizer steps: a semantics change, opt-in "
                         "(env PIO_BATCH_AUTOSCALE=on)")
+    t.add_argument("--pq", dest="pq", default=None, metavar="auto|on|off",
+                   help="quantized-corpus build policy: serialize "
+                        "residual PQ codes (1+M bytes/item) next to the "
+                        "IVF index so serving LUT-scans the packed "
+                        "codes and re-ranks a shortlist exactly "
+                        "(default: env PIO_PQ, else auto — builds above "
+                        "PIO_PQ_MIN_ITEMS)")
+    t.add_argument("--pq-m", dest="pq_m", type=int, default=0,
+                   metavar="M",
+                   help="PQ subspace count (bytes/item = 1+M; default: "
+                        "env PIO_PQ_M, else ~dim/4)")
     t.add_argument("--follow", action="store_true",
                    help="continuous refresh: retrain on a cadence "
                         "(delta warm-start when possible), promote "

@@ -57,7 +57,8 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
 
 from predictionio_tpu.data.fusion import FusionPlan
 
-__all__ = ["DevicePrefetcher", "PrefetchedBatch", "prefetch_depth"]
+__all__ = ["DevicePrefetcher", "PrefetchedBatch", "prefetch_depth",
+           "StagingPool"]
 
 # Live prefetchers, swept at interpreter exit: a prep thread still inside
 # a device transfer or a native-feeder call while CPython tears down is a
@@ -81,6 +82,65 @@ DEFAULT_DEPTH = 2
 # put/get with a timeout wake immediately on space/data; the timeout only
 # bounds how stale a stop request can go unnoticed.
 _POLL_S = 0.05
+
+_PAGE_ALIGN = 4096  # host staging buffers align to a page boundary
+
+
+def _aligned_empty(shape, dtype, align: int = _PAGE_ALIGN):
+    """Uninitialized host array whose data pointer is page-aligned —
+    what a PCIe DMA engine wants to see on the staging side."""
+    import numpy as np
+
+    dt = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dt.itemsize
+    raw = np.empty(nbytes + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + nbytes].view(dt).reshape(shape)
+
+
+class StagingPool:
+    """Ring of page-aligned, REUSABLE host buffers for superbatch
+    assembly (carried since PR 5: PCIe hosts paid a fresh multi-MB
+    allocation + page-fault walk per fused window).
+
+    One ring per (shape, dtype) key; the first ``slots`` requests
+    allocate, later ones rotate through the ring.  Safety contract: a
+    buffer handed out is rewritten only after ``slots`` newer windows
+    were staged — with ``slots = depth + 2`` the transfer of the batch
+    it carried completed long before reuse *provided the device put
+    COPIES the host memory* (every PCIe backend does; the CPU backend
+    may alias numpy buffers zero-copy, which is why pooling is gated
+    off there — see ``DevicePrefetcher`` ``pin_buffers``).
+
+    Single-producer by design: only the prep thread touches a pool.
+    """
+
+    __slots__ = ("slots", "_rings", "_next", "reused", "allocated")
+
+    def __init__(self, slots: int):
+        self.slots = max(int(slots), 2)
+        self._rings: dict = {}
+        self._next: dict = {}
+        self.reused = 0
+        self.allocated = 0
+
+    def take(self, shape, dtype, tag: int = 0):
+        import numpy as np
+
+        # ``tag`` separates pytree leaves that share a shape/dtype —
+        # two leaves drawing from one ring would halve the rotation
+        # distance the safety contract is built on.
+        key = (tag, tuple(int(s) for s in shape), np.dtype(dtype).str)
+        ring = self._rings.setdefault(key, [])
+        if len(ring) < self.slots:
+            buf = _aligned_empty(shape, dtype)
+            ring.append(buf)
+            self.allocated += 1
+            return buf
+        i = self._next.get(key, 0)
+        self._next[key] = (i + 1) % self.slots
+        self.reused += 1
+        return ring[i]
 
 
 def prefetch_depth(default: int = DEFAULT_DEPTH) -> int:
@@ -165,6 +225,7 @@ class DevicePrefetcher:
         fuse_steps: int = 1,
         batch_scale: int = 1,
         fuse_plan: Optional[FusionPlan] = None,
+        pin_buffers: Optional[bool] = None,
         count_fn: Optional[Callable[[Any], int]] = None,
         clock: Callable[[], float] = time.perf_counter,
         wall_clock: Callable[[], float] = time.time,
@@ -190,6 +251,15 @@ class DevicePrefetcher:
         w = self._plan.window_batches
         self._realign = (w - self._skip % w) % w if (self._skip and w > 1) \
             else 0
+        # Pinned host staging (ISSUE 13 satellite): superbatch assembly
+        # reuses page-aligned buffers instead of allocating per window.
+        # None = resolve lazily at the first multi-batch emit
+        # (PIO_PINNED_STAGING on|off|auto; auto = any non-CPU backend —
+        # the CPU backend may alias numpy buffers into its "device"
+        # arrays zero-copy, and a reused buffer would then rewrite a
+        # staged batch in flight).
+        self._pin = pin_buffers
+        self._pool: Optional[StagingPool] = None
         self._clock = clock
         self._wall_clock = wall_clock
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
@@ -203,13 +273,19 @@ class DevicePrefetcher:
         self._staged = 0
         self._staged_lock = threading.Lock()
         self._depth_gauge = None
+        self._pinned_counter = None
         if model:
             from predictionio_tpu.obs.metrics import get_registry
 
-            self._depth_gauge = (registry or get_registry()).gauge(
+            reg = registry or get_registry()
+            self._depth_gauge = reg.gauge(
                 "pio_prefetch_queue_depth",
                 "Staged batches waiting in the prefetch queue.",
                 ("model",))
+            self._pinned_counter = reg.counter(
+                "pio_prefetch_pinned_reuse_total",
+                "Superbatch stagings that reused a pinned host buffer "
+                "instead of allocating.", ("model",))
             self._model = model
         self._thread = threading.Thread(
             target=self._run, name=f"pio-prefetch-{model or 'batch'}",
@@ -307,13 +383,48 @@ class DevicePrefetcher:
                     self._depth_gauge.set(staged, model=self._model)
             return True
 
+    def _staging_pool(self) -> Optional[StagingPool]:
+        """The prep thread's buffer pool, or None when pinned staging is
+        off.  Resolved once, at the first multi-batch emit, so the
+        unfused path never pays the backend probe (and a jax-free test
+        process never imports jax unless it opted in)."""
+        if self._pin is None:
+            raw = os.environ.get("PIO_PINNED_STAGING",
+                                 "auto").strip().lower()
+            if raw in ("on", "1", "true", "yes"):
+                self._pin = True
+            elif raw in ("off", "0", "false", "no"):
+                self._pin = False
+            else:
+                try:
+                    import jax
+
+                    self._pin = jax.default_backend() != "cpu"
+                except Exception:
+                    self._pin = False
+        if self._pin and self._pool is None:
+            # depth staged + 1 in the consumer's hands + 1 margin for an
+            # asynchronously-draining transfer = safe rotation distance.
+            self._pool = StagingPool(self.depth + 2)
+        return self._pool if self._pin else None
+
+    def _note_pinned(self, pool: Optional[StagingPool],
+                     reused_before: int) -> None:
+        if pool is not None and self._pinned_counter is not None \
+                and pool.reused > reused_before:
+            self._pinned_counter.inc(pool.reused - reused_before,
+                                     model=self._model)
+
     def _emit_slot(self, entries: List[Tuple[Any, int, float, int]]) -> bool:
         """Stage one optimizer step's batch: a single prepped batch, or
         ``batch_scale`` prepped batches concatenated (both ride
         ``put_fn`` — no leading scan axis)."""
         t0 = self._clock()
+        pool = self._staging_pool() if len(entries) > 1 else None
+        reused = pool.reused if pool is not None else 0
         arrays = entries[0][0] if len(entries) == 1 \
-            else _tree_concat([e[0] for e in entries])
+            else _tree_concat([e[0] for e in entries], pool)
+        self._note_pinned(pool, reused)
         staged = self._put_fn(arrays)
         h2d_ms = sum(e[2] for e in entries) + (self._clock() - t0) * 1e3
         return self._offer(PrefetchedBatch(
@@ -328,10 +439,15 @@ class DevicePrefetcher:
         if k <= 1:
             return self._emit_slot(window)
         t0 = self._clock()
+        pool = self._staging_pool()
+        reused = pool.reused if pool is not None else 0
         slots = [window[i * m:(i + 1) * m] for i in range(k)]
+        # Only the FINAL superbatch rides the pool — inner batch-scale
+        # concats are transients the stack copies out of immediately.
         arrays = _tree_stack([
             s[0][0] if m == 1 else _tree_concat([e[0] for e in s])
-            for s in slots])
+            for s in slots], pool)
+        self._note_pinned(pool, reused)
         staged = self._fused_put_fn(arrays)
         h2d_ms = sum(e[2] for e in window) + (self._clock() - t0) * 1e3
         return self._offer(PrefetchedBatch(
@@ -417,26 +533,66 @@ def _default_put(arrays: Any) -> Any:
     return jax.device_put(arrays)
 
 
-def _tree_stack(items: List[Any]) -> Any:
+def _pooled_stack(leaves: List[Any], pool: Optional[StagingPool],
+                  tag: int = 0):
+    """np.stack, assembled into a reusable page-aligned buffer when a
+    pool is active and the leaves agree on shape/dtype (a ragged window
+    falls back to a fresh allocation — correctness over reuse)."""
+    import numpy as np
+
+    first = leaves[0]
+    if pool is None or any(
+            getattr(leaf, "shape", None) != first.shape
+            or getattr(leaf, "dtype", None) != first.dtype
+            for leaf in leaves):
+        return np.stack(leaves)
+    out = pool.take((len(leaves),) + tuple(first.shape), first.dtype,
+                    tag=tag)
+    for i, leaf in enumerate(leaves):
+        np.copyto(out[i], leaf)
+    return out
+
+
+def _pooled_concat(leaves: List[Any], pool: Optional[StagingPool],
+                   tag: int = 0):
+    """np.concatenate into a reusable buffer (same fallback rules as
+    :func:`_pooled_stack`; rows may differ, trailing dims may not)."""
+    import numpy as np
+
+    first = leaves[0]
+    if pool is None or any(
+            getattr(leaf, "shape", ())[1:] != first.shape[1:]
+            or getattr(leaf, "dtype", None) != first.dtype
+            for leaf in leaves):
+        return np.concatenate(leaves)
+    rows = sum(leaf.shape[0] for leaf in leaves)
+    out = pool.take((rows,) + tuple(first.shape[1:]), first.dtype,
+                    tag=tag)
+    off = 0
+    for leaf in leaves:
+        np.copyto(out[off:off + leaf.shape[0]], leaf)
+        off += leaf.shape[0]
+    return out
+
+
+def _tree_stack(items: List[Any],
+                pool: Optional[StagingPool] = None) -> Any:
     """Stack prepped batches leaf-wise along a NEW leading axis (the scan
     axis of a fused superbatch).  Batches are tuples/lists of arrays by
     the prep convention; a bare array stacks directly."""
-    import numpy as np
-
     if isinstance(items[0], (tuple, list)):
         return type(items[0])(
-            np.stack([it[j] for it in items])
+            _pooled_stack([it[j] for it in items], pool, tag=j)
             for j in range(len(items[0])))
-    return np.stack(items)
+    return _pooled_stack(items, pool)
 
 
-def _tree_concat(items: List[Any]) -> Any:
+def _tree_concat(items: List[Any],
+                 pool: Optional[StagingPool] = None) -> Any:
     """Concatenate prepped batches leaf-wise along the batch axis (the
     batch-autoscale widening)."""
-    import numpy as np
-
     if isinstance(items[0], (tuple, list)):
         return type(items[0])(
-            np.concatenate([it[j] for it in items])
+            _pooled_concat([it[j] for it in items], pool, tag=j)
             for j in range(len(items[0])))
-    return np.concatenate(items)
+    return _pooled_concat(items, pool)

@@ -14,8 +14,15 @@ facade puts three rungs behind one call:
    sublinear candidate scan, versioned with the model generation via a
    corpus fingerprint (an index that does not match the vectors it is
    served next to is dropped loudly, never silently mis-served).
-3. **Fused kernel** (``ops/pallas_kernels.fused_topk``) — rides inside
-   the chunked rung where the backend supports it.
+3. **PQ** (``retrieval/pq.py``, ISSUE 13) — train-time residual product
+   quantization: the resident corpus shrinks to 1+M bytes/item, serving
+   LUT-scores packed codes (``ivf_pq`` prunes by cell first; ``pq_flat``
+   scans every code row) and re-ranks a ``PIO_PQ_RERANK`` shortlist
+   against exact embeddings so recall never rides quantization error.
+   Codebooks carry the same fingerprint tripwire as the IVF index.
+4. **Fused kernels** (``ops/pallas_kernels.fused_topk`` /
+   ``pq_scan``) — ride inside the chunked and PQ rungs where the
+   backend supports them.
 
 Templates hold ONE :class:`Retriever` per loaded model (via
 :func:`cached_retriever` — weak-keyed, so it dies with the generation)
@@ -25,7 +32,8 @@ primitives directly.
 
 Routing knobs (all read per request, so ops can retune a live server):
 
-- ``PIO_RETRIEVAL_RUNG`` — auto|host|device|chunked|sharded|ivf (force)
+- ``PIO_RETRIEVAL_RUNG`` — auto|host|device|chunked|sharded|ivf|ivf_pq|
+  pq_flat (force)
 - ``PIO_SERVE_HOST_MACS`` — host fast path when B·N·D is at or below
   this (default 2e8): one device dispatch round-trip costs more than
   that many host MACs, which is exactly the lone-client B=1 case
@@ -33,6 +41,8 @@ Routing knobs (all read per request, so ops can retune a live server):
 - ``PIO_SERVE_SHARD_ABOVE`` — shard-at-load threshold (see
   :meth:`Retriever.maybe_shard`)
 - ``PIO_IVF_NPROBE`` — IVF lists probed per query
+- ``PIO_PQ_RERANK`` — exact-re-rank shortlist size (default 4·k)
+- ``PIO_CORPUS_DTYPE`` — f32|bf16|int8 staged re-rank corpus
 
 Observability: ``pio_retrieval_requests_total{rung}``,
 ``pio_retrieval_candidates_total{rung}`` (rows actually scored),
@@ -63,11 +73,22 @@ from predictionio_tpu.retrieval.ivf import (
     search_ivf_device,
     search_ivf_host,
 )
+from predictionio_tpu.retrieval.pq import (
+    PQCodebook,
+    build_pq,
+    pq_build_config,
+    quantize_int8,
+    search_ivf_pq_device,
+    search_ivf_pq_host,
+    search_pq_device,
+    search_pq_host,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["Retriever", "Plan", "cached_retriever", "iter_hits",
-           "build_train_index", "IVFIndex", "build_ivf",
+           "build_train_index", "build_train_pq", "IVFIndex",
+           "PQCodebook", "build_ivf", "build_pq",
            "corpus_fingerprint", "K_MENU"]
 
 # Compiled-program menu (SURVEY §7): K pads up so the serving frontend's
@@ -75,7 +96,11 @@ __all__ = ["Retriever", "Plan", "cached_retriever", "iter_hits",
 K_MENU = (1, 10, 100, 1000)
 _NEG_SENTINEL = -1e37  # scores at/below this are padding, never results
 
-RUNGS = ("host", "device", "chunked", "sharded", "ivf")
+RUNGS = ("host", "device", "chunked", "sharded", "ivf", "ivf_pq",
+         "pq_flat")
+# Rungs that honor a per-request exclude mask (everything else pins the
+# query to an exact rung — a blacklisted id must never be returned).
+EXCLUDE_RUNGS = ("host", "device", "chunked")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -96,6 +121,7 @@ class Plan:
     rung: str
     k: int
     nprobe: int = 0
+    rerank: int = 0  # PQ rungs: exact-re-scored shortlist size
 
 
 class Retriever:
@@ -109,7 +135,8 @@ class Retriever:
     """
 
     def __init__(self, item_vecs, *, n_items: Optional[int] = None,
-                 ivf: Optional[IVFIndex] = None, name: str = "default",
+                 ivf: Optional[IVFIndex] = None,
+                 pq: Optional[PQCodebook] = None, name: str = "default",
                  host_fn=None):
         self._vecs = item_vecs
         self.n_items = int(n_items if n_items is not None
@@ -120,13 +147,20 @@ class Retriever:
         self._host: Optional[np.ndarray] = None
         self._dev = None
         self._jit: Dict = {}
-        # RLock: ivf_index() validates the fingerprint under the lock and
-        # that validation stages host_vecs(), which locks again.
+        # RLock: ivf_index()/pq_codebook() validate fingerprints under
+        # the lock and that validation stages host_vecs(), which locks
+        # again.
         self._lock = threading.RLock()
         self._ivf_raw = ivf
         self._ivf: Optional[IVFIndex] = None
         self._ivf_checked = False
         self._ivf_dev = None
+        self._pq_raw = pq
+        self._pq: Optional[PQCodebook] = None
+        self._pq_checked = False
+        self._pq_dev = None
+        self._rerank_dev: Dict = {}
+        self._fp: Optional[str] = None
         reg = get_registry()
         self._m_requests = reg.counter(
             "pio_retrieval_requests_total",
@@ -139,6 +173,10 @@ class Retriever:
         self._m_ivf_rejected = reg.counter(
             "pio_retrieval_ivf_rejected_total",
             "IVF indexes dropped for a fingerprint mismatch with the "
+            "served corpus.", ("corpus",))
+        self._m_pq_rejected = reg.counter(
+            "pio_retrieval_pq_rejected_total",
+            "PQ codebooks dropped for a fingerprint mismatch with the "
             "served corpus.", ("corpus",))
 
     # -- corpus staging -----------------------------------------------------
@@ -220,9 +258,22 @@ class Retriever:
         self._vecs = put_sharded(vecs, mesh, NamedSharding(mesh, P(axis)))
         self._dev = None
         self._jit = {}
+        # The f32 re-rank staging may hold the pre-shard unsharded
+        # device copy — drop it so the post-shard resolution (host-copy
+        # based) applies and the old whole-corpus buffer can free.
+        self._rerank_dev = {}
         return True
 
-    # -- IVF lifecycle ------------------------------------------------------
+    # -- IVF / PQ lifecycle --------------------------------------------------
+
+    def _corpus_fp(self) -> str:
+        """SHA-1 of the served corpus — computed once, shared by the IVF
+        and PQ tripwires (each validation used to re-hash the matrix)."""
+        if self._fp is None:
+            with self._lock:
+                if self._fp is None:
+                    self._fp = corpus_fingerprint(self.host_vecs())
+        return self._fp
 
     def ivf_index(self) -> Optional[IVFIndex]:
         """The generation's IVF index, fingerprint-validated ONCE against
@@ -237,8 +288,7 @@ class Retriever:
             idx = self._ivf_raw
             if idx is not None:
                 if (idx.n_items != self.n_items or idx.dim != self.dim
-                        or idx.fingerprint
-                        != corpus_fingerprint(self.host_vecs())):
+                        or idx.fingerprint != self._corpus_fp()):
                     logger.error(
                         "IVF index fingerprint mismatch for corpus %r "
                         "(index n=%d/d=%d vs corpus n=%d/d=%d) — dropping "
@@ -264,6 +314,103 @@ class Retriever:
                                      jnp.asarray(idx.lists))
         return self._ivf_dev
 
+    def pq_codebook(self) -> Optional[PQCodebook]:
+        """The generation's PQ codebook, fingerprint-validated ONCE
+        against the served corpus.  A mismatched codebook (codes from
+        another generation next to these vectors) is dropped loudly —
+        exact serving continues, results are never silently wrong."""
+        if self._pq_checked:
+            return self._pq
+        with self._lock:
+            if self._pq_checked:
+                return self._pq
+            pq = self._pq_raw
+            if pq is not None:
+                if (pq.n_items != self.n_items or pq.dim != self.dim
+                        or pq.fingerprint != self._corpus_fp()):
+                    logger.error(
+                        "PQ codebook fingerprint mismatch for corpus %r "
+                        "(codes n=%d/d=%d vs corpus n=%d/d=%d) — "
+                        "dropping the codebook; serving stays exact",
+                        self.name, pq.n_items, pq.dim, self.n_items,
+                        self.dim)
+                    self._m_pq_rejected.inc(corpus=self.name)
+                    pq = None
+            self._pq = pq
+            self._pq_checked = True
+        return self._pq
+
+    def pq_device_arrays(self):
+        """Coarse book [256, D] + codebooks [M, 256, D/M] + the packed
+        code matrix TRANSPOSED to scan layout [1+M, N] uint8 — staged on
+        device ONCE per generation (the code matrix IS the resident
+        quantized corpus; re-uploading it per request would defeat the
+        whole memory story)."""
+        if self._pq_dev is None:
+            with _exact.SERVE_CACHE_LOCK:
+                if self._pq_dev is None:
+                    import jax.numpy as jnp
+
+                    pq = self.pq_codebook()
+                    self._pq_dev = (
+                        jnp.asarray(pq.coarse),
+                        jnp.asarray(pq.codebooks),
+                        jnp.asarray(np.ascontiguousarray(pq.codes.T)))
+        return self._pq_dev
+
+    def rerank_arrays(self):
+        """The staged exact re-rank corpus under ``PIO_CORPUS_DTYPE``:
+        ``(vectors, None)`` for f32/bf16 or ``(int8, row_scales)`` —
+        per-dtype copies staged once so a live retune of the env never
+        re-uploads on the hot path.  f32 reuses the exact rungs' staged
+        device copy outright."""
+        raw = os.environ.get("PIO_CORPUS_DTYPE", "f32").strip().lower() \
+            or "f32"
+        dtype = {"f32": "f32", "float32": "f32", "bf16": "bf16",
+                 "bfloat16": "bf16", "int8": "int8"}.get(raw)
+        if dtype is None:
+            logger.warning("PIO_CORPUS_DTYPE=%r is not one of "
+                           "f32|bf16|int8; staging f32", raw)
+            dtype = "f32"
+        staged = self._rerank_dev.get(dtype)
+        if staged is not None:
+            return staged
+        if dtype == "f32" and self.n_items * self.dim * 4 > 1 << 28:
+            # The default keeps the re-rank corpus exact, but above
+            # ~256 MB that re-stages the very fp32 residency PQ exists
+            # to remove — say so ONCE, with the fix, instead of letting
+            # the first request OOM a chip that only fits the codes.
+            logger.warning(
+                "PQ re-rank corpus %r stages %.0f MB of fp32 on device "
+                "(PIO_CORPUS_DTYPE=f32 default); set "
+                "PIO_CORPUS_DTYPE=bf16 or int8 to shrink the resident "
+                "re-rank copy 2-4x", self.name,
+                self.n_items * self.dim * 4 / 2 ** 20)
+        if dtype == "f32" and not self.sharded:
+            # device_vecs() takes SERVE_CACHE_LOCK itself — stage it
+            # BEFORE acquiring the lock here (non-reentrant).
+            staged = (self.device_vecs(), None)
+            self._rerank_dev[dtype] = staged
+            return staged
+        with _exact.SERVE_CACHE_LOCK:
+            staged = self._rerank_dev.get(dtype)
+            if staged is None:
+                import jax.numpy as jnp
+
+                if dtype == "f32":
+                    # A mesh-sharded corpus can't feed the PQ gather
+                    # directly; re-rank gets its own unsharded copy
+                    # (pick bf16/int8 at this scale).
+                    staged = (jnp.asarray(self.host_vecs()), None)
+                elif dtype == "bf16":
+                    staged = (jnp.asarray(self.host_vecs(),
+                                          jnp.bfloat16), None)
+                else:
+                    q8, sc = quantize_int8(self.host_vecs())
+                    staged = (jnp.asarray(q8), jnp.asarray(sc))
+                self._rerank_dev[dtype] = staged
+        return staged
+
     # -- routing ------------------------------------------------------------
 
     def plan(self, b: int, num: int, *, has_exclude: bool = False) -> Plan:
@@ -276,7 +423,7 @@ class Retriever:
             logger.warning("PIO_RETRIEVAL_RUNG=%r is not one of %s; "
                            "auto routing", forced, ("auto",) + RUNGS)
         if forced in RUNGS:
-            if has_exclude and forced not in ("host", "device", "chunked"):
+            if has_exclude and forced not in EXCLUDE_RUNGS:
                 # The sharded/IVF executors take no per-request mask —
                 # honoring the exclusion beats honoring the forcing (a
                 # blacklisted item must never be returned).
@@ -294,6 +441,17 @@ class Retriever:
                 logger.warning("PIO_RETRIEVAL_RUNG=ivf but corpus %r has "
                                "no valid index; serving exact", self.name)
                 forced = "auto"
+            if forced in ("ivf_pq", "pq_flat") \
+                    and self.pq_codebook() is None:
+                logger.warning("PIO_RETRIEVAL_RUNG=%s but corpus %r has "
+                               "no valid PQ codebook; serving exact",
+                               forced, self.name)
+                forced = "auto"
+            if forced == "ivf_pq" and self.ivf_index() is None:
+                logger.warning("PIO_RETRIEVAL_RUNG=ivf_pq but corpus %r "
+                               "has no valid IVF index; serving pq_flat",
+                               self.name)
+                forced = "pq_flat"
             if forced in RUNGS:
                 return self._finish_plan(forced, b, k)
         work = b * self.n_items * self.dim
@@ -301,13 +459,22 @@ class Retriever:
         if has_exclude:
             # Per-request [B, N] masks ride the exact rungs only (an
             # excluded id must never cost recall the way an unprobed
-            # IVF cell would); past the chunk threshold the mask rides
-            # the scan so score memory stays bounded at [B, chunk].
+            # IVF cell or a quantized shortlist would); past the chunk
+            # threshold the mask rides the scan so score memory stays
+            # bounded at [B, chunk].
             if work <= host_macs:
                 return self._finish_plan("host", b, k)
             if self.n_items > _env_int("PIO_SERVE_CHUNK_ABOVE", 2_000_000):
                 return self._finish_plan("chunked", b, k)
             return self._finish_plan("device", b, k)
+        if self.pq_codebook() is not None:
+            # Quantized serving when the generation carries codes:
+            # IVF-pruned when it also carries a valid index, full LUT
+            # scan otherwise (the norm-variant / opted-out-of-IVF
+            # shape) — the exact re-rank holds recall either way.
+            if self.ivf_index() is not None:
+                return self._finish_plan("ivf_pq", b, k)
+            return self._finish_plan("pq_flat", b, k)
         if self.ivf_index() is not None:
             return self._finish_plan("ivf", b, k)
         if work <= host_macs:
@@ -318,14 +485,36 @@ class Retriever:
             return self._finish_plan("chunked", b, k)
         return self._finish_plan("device", b, k)
 
+    def _rerank_count(self, k: int) -> int:
+        """PQ shortlist size: ``PIO_PQ_RERANK`` (absolute), default 4·k —
+        clamped to [k, n_items].  The top-k the caller sees is always
+        computed from exact scores over this many candidates."""
+        raw = os.environ.get("PIO_PQ_RERANK", "").strip()
+        r = 0
+        if raw:
+            try:
+                r = int(raw)
+            except ValueError:
+                logger.warning("PIO_PQ_RERANK=%r is not an integer; "
+                               "using the 4·k default", raw)
+        if r <= 0:
+            r = 4 * k
+        return min(self.n_items, max(r, k))
+
     def _finish_plan(self, rung: str, b: int, k: int) -> Plan:
-        if rung != "ivf":
+        if rung == "pq_flat":
+            return Plan(rung=rung, k=k, rerank=self._rerank_count(k))
+        if rung not in ("ivf", "ivf_pq"):
             return Plan(rung=rung, k=k)
         idx = self.ivf_index()
-        # Static-shape guard: the probed lists must reach k REAL
-        # candidates even for the query landing on the shortest lists.
+        # Static-shape guard: the probed lists must reach k (or, with a
+        # PQ shortlist, rerank) REAL candidates even for the query
+        # landing on the shortest lists.
+        reach = self._rerank_count(k) if rung == "ivf_pq" else k
         nprobe = min(idx.nlist,
-                     max(idx.default_nprobe(), idx.min_nprobe_for(k)))
+                     max(idx.default_nprobe(), idx.min_nprobe_for(reach)))
+        if rung == "ivf_pq":
+            return Plan(rung=rung, k=k, nprobe=nprobe, rerank=reach)
         return Plan(rung="ivf", k=k, nprobe=nprobe)
 
     # -- the one entry point ------------------------------------------------
@@ -347,8 +536,10 @@ class Retriever:
         with span("retrieval", corpus=self.name, rung=p.rung, batch=b,
                   k=p.k) as sp:
             scores, ids, scanned = self._execute(q, p, exclude)
-            if p.rung == "ivf":
+            if p.nprobe:
                 sp.set(nprobe=p.nprobe)
+            if p.rerank:
+                sp.set(rerank=p.rerank)
             sp.set(candidates=scanned)
         ms = (time.perf_counter() - t0) * 1e3
         self._m_requests.inc(rung=p.rung, corpus=self.name)
@@ -360,7 +551,7 @@ class Retriever:
         record_stage("retrieval", ms, rung=p.rung,
                      retrievalCandidates=scanned)
         info = {"rung": p.rung, "k": p.k, "nprobe": p.nprobe,
-                "candidates": scanned, "ms": ms}
+                "rerank": p.rerank, "candidates": scanned, "ms": ms}
         return scores, ids, info
 
     def _execute(self, q: np.ndarray, p: Plan,
@@ -370,6 +561,8 @@ class Retriever:
             s, i = _exact.exact_host(q, self.host_vecs(), p.k,
                                      exclude=exclude)
             return s, i, b * self.n_items
+        if p.rung in ("pq_flat", "ivf_pq"):
+            return self._execute_pq(q, p)
         if p.rung == "ivf":
             idx = self.ivf_index()
             # The sub-linear scan keeps the same host-vs-device economics
@@ -405,6 +598,40 @@ class Retriever:
                                        jit_cache=self._jit,
                                        exclude=exclude)
         return s[:b], i[:b], b * self.n_items
+
+    def _execute_pq(self, q: np.ndarray, p: Plan):
+        """Quantized rungs: LUT scan (IVF-pruned or full) → exact
+        re-rank.  Same host-vs-device economics as the other rungs,
+        judged on code rows touched (≈1 lookup ≈ 1 MAC) plus the
+        re-rank matmul."""
+        b = q.shape[0]
+        pq = self.pq_codebook()
+        host_macs = _env_int("PIO_SERVE_HOST_MACS", 2 * 10 ** 8)
+        rerank_macs = b * p.rerank * self.dim
+        if p.rung == "pq_flat":
+            est = b * self.n_items * pq.n_tables + rerank_macs
+            if est <= host_macs:
+                return search_pq_host(pq, self.host_vecs(), q, p.k,
+                                      p.rerank)
+            qp = _pow2_pad(q)
+            s, i, scanned = search_pq_device(
+                pq, qp, p.k, p.rerank, jit_cache=self._jit,
+                consts=self.pq_device_arrays(),
+                rerank_consts=self.rerank_arrays())
+            return s[:b], i[:b], int(scanned * b / max(len(qp), 1))
+        idx = self.ivf_index()
+        est = b * p.nprobe * idx.pad_len * pq.n_tables + rerank_macs
+        if est <= host_macs:
+            return search_ivf_pq_host(idx, pq, self.host_vecs(), q, p.k,
+                                      p.nprobe, p.rerank)
+        qp = _pow2_pad(q)
+        s, i, scanned = search_ivf_pq_device(
+            idx, pq, qp, p.k, p.nprobe, p.rerank, jit_cache=self._jit,
+            ivf_consts=self.ivf_device_arrays(),
+            pq_consts=self.pq_device_arrays(),
+            rerank_consts=self.rerank_arrays())
+        # scanned counts the padded batch's probes; rescale to real.
+        return s[:b], i[:b], int(scanned * b / max(len(qp), 1))
 
 
 def _pow2_pad(q: np.ndarray) -> np.ndarray:
@@ -486,3 +713,32 @@ def build_train_index(item_vecs: np.ndarray, *, name: str,
     logger.info("IVF index for %r built in %.1fs (nlist=%d)", name,
                 time.perf_counter() - t0, idx.nlist if idx else -1)
     return idx
+
+
+def build_train_pq(item_vecs: np.ndarray, *, name: str,
+                   ivf: Optional[IVFIndex] = None,
+                   seed: Optional[int] = None) -> Optional[PQCodebook]:
+    """Train-time residual-PQ build under the env policy (``PIO_PQ`` /
+    ``PIO_PQ_M`` / ``PIO_PQ_MIN_ITEMS``) — called by template
+    ``train()`` AFTER the IVF build so the residual coarse book can ride
+    the same cell structure, and serialized inside the SAME model
+    artifact the generation swap moves.
+
+    Unlike IVF, PQ needs no norm-variance opt-in: the exact re-rank
+    re-scores every returned candidate against the true embeddings, so
+    quantization error orders a shortlist but never the final top-k.
+    """
+    vecs = np.asarray(item_vecs, dtype=np.float32)
+    build, m, min_items = pq_build_config(len(vecs), vecs.shape[1])
+    if not build:
+        logger.debug("PQ build skipped for %r (n=%d < min=%d or PIO_PQ "
+                     "off)", name, len(vecs), min_items)
+        return None
+    t0 = time.perf_counter()
+    # seed=None pins to 0 like build_train_index — identical data must
+    # build identical codes or recall/bench comparisons drift.
+    pq = build_pq(vecs, m=m, ivf=ivf, seed=0 if seed is None else seed)
+    logger.info("PQ codebook for %r built in %.1fs (M=%d, %d B/item)",
+                name, time.perf_counter() - t0, pq.m,
+                pq.bytes_per_item())
+    return pq

@@ -42,8 +42,10 @@ from predictionio_tpu.models import als as als_lib
 from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
 from predictionio_tpu.retrieval import (
     IVFIndex,
+    PQCodebook,
     Retriever,
     build_train_index,
+    build_train_pq,
     cached_retriever,
     iter_hits,
 )
@@ -297,6 +299,12 @@ class ALSModelWrapper:
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Residual PQ codes (ISSUE 13): unlike IVF, safe for these
+    # norm-variant factors WITHOUT an opt-in — the exact re-rank
+    # re-scores every returned candidate, so quantization error orders
+    # a shortlist but never the final top-k.  Same atomic-swap +
+    # fingerprint-tripwire contract as ``ivf``.
+    pq: Optional[PQCodebook] = None
     # Training-time score-distribution baseline (ISSUE 11): rides the
     # same atomic-swap contract as ``ivf`` — serving drift is judged
     # against THIS generation's own baseline.
@@ -361,6 +369,7 @@ class ALSModelWrapper:
             self.model.item_factors,
             n_items=len(self.item_index),
             ivf=getattr(self, "ivf", None),
+            pq=getattr(self, "pq", None),
             name="als",
             host_fn=lambda: ref().host_factors()[1]))
 
@@ -548,17 +557,23 @@ class ALSAlgorithm(Algorithm):
             jax.device_get(model.item_factors))[: len(prepared_data.item_index)]
         uf_host = np.asarray(
             jax.device_get(model.user_factors))[: len(prepared_data.user_index)]
+        # Train-time coarse index — serialized with the model so the
+        # generation swap moves both atomically.  Raw ALS factors
+        # carry popularity-scaled norms (a poor IVF fit: cells
+        # partition by direction), so the index builds only under an
+        # explicit PIO_IVF=on, never auto.
+        ivf_idx = build_train_index(itf_host, name="als", seed=cfg.seed,
+                                    require_explicit=True)
         return ALSModelWrapper(
             model=model,
             user_index=prepared_data.user_index,
             item_index=prepared_data.item_index,
-            # Train-time coarse index — serialized with the model so the
-            # generation swap moves both atomically.  Raw ALS factors
-            # carry popularity-scaled norms (a poor IVF fit: cells
-            # partition by direction), so the index builds only under an
-            # explicit PIO_IVF=on, never auto.
-            ivf=build_train_index(itf_host, name="als", seed=cfg.seed,
-                                  require_explicit=True),
+            ivf=ivf_idx,
+            # Residual PQ codes (ISSUE 13): auto-gated like the deep
+            # templates — the exact re-rank makes quantization safe for
+            # norm-variant factors, so no explicit opt-in is required.
+            pq=build_train_pq(itf_host, name="als", ivf=ivf_idx,
+                              seed=cfg.seed),
             # Quality baseline (ISSUE 11): top-K reconstruction scores
             # of a seeded user sample against the item factors — the
             # population serving's itemScores come from.

@@ -36,8 +36,10 @@ from predictionio_tpu.models import two_tower as tt_lib
 from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
 from predictionio_tpu.retrieval import (
     IVFIndex,
+    PQCodebook,
     Retriever,
     build_train_index,
+    build_train_pq,
     cached_retriever,
     iter_hits,
 )
@@ -131,6 +133,10 @@ class TwoTowerModelWrapper:
     user_index: BiMap
     item_index: BiMap
     ivf: Optional[IVFIndex] = None
+    # Residual PQ codes + codebooks (ISSUE 13): same atomic-swap
+    # contract as ``ivf`` — the quantized corpus a generation serves is
+    # ALWAYS the one built over its own vectors, fingerprint-pinned.
+    pq: Optional[PQCodebook] = None
     # Training-time score-distribution baseline (ISSUE 11): rides the
     # same atomic-swap contract as ``ivf`` — serving drift is always
     # judged against THIS generation's own baseline, fingerprint-pinned
@@ -153,6 +159,7 @@ class TwoTowerModelWrapper:
             self.item_vecs,
             n_items=len(self.item_index),
             ivf=getattr(self, "ivf", None),
+            pq=getattr(self, "pq", None),
             name="twotower"))
 
     def post_load(self, ctx) -> None:
@@ -212,16 +219,22 @@ class TwoTowerAlgorithm(Algorithm):
             tt_lib.encode_users(state.params, jnp.arange(cfg.n_users)))
         item_vecs = np.asarray(
             tt_lib.encode_items(state.params, jnp.arange(cfg.n_items)))
+        # Train-time coarse index (policy-gated: PIO_IVF /
+        # PIO_IVF_MIN_ITEMS) — the normalized tower outputs are the
+        # IVF design target; serialized with the model so the
+        # generation swap moves both atomically.
+        ivf = build_train_index(item_vecs, name="twotower",
+                                seed=cfg.seed)
         return TwoTowerModelWrapper(
             user_vecs=user_vecs, item_vecs=item_vecs,
             user_index=user_index,
             item_index=item_index,
-            # Train-time coarse index (policy-gated: PIO_IVF /
-            # PIO_IVF_MIN_ITEMS) — the normalized tower outputs are the
-            # IVF design target; serialized with the model so the
-            # generation swap moves both atomically.
-            ivf=build_train_index(item_vecs, name="twotower",
-                                  seed=cfg.seed),
+            ivf=ivf,
+            # Residual PQ codes (policy-gated: PIO_PQ / PIO_PQ_M /
+            # PIO_PQ_MIN_ITEMS), built on top of the IVF coarse
+            # structure and swapped with it.
+            pq=build_train_pq(item_vecs, name="twotower", ivf=ivf,
+                              seed=cfg.seed),
             # Quality baseline (ISSUE 11): top-K scores of a seeded user
             # sample against the full corpus — the same population
             # serving emits, so serve-time PSI compares like with like.

@@ -52,6 +52,25 @@ def predict(model, q):
     assert all("Retriever.topk" in v for v in violations)
 
 
+def test_detects_pq_primitives_and_module_import():
+    """ISSUE 13: pq_scan / codebook access only via the facade — a
+    handler LUT-scoring codes directly would skip the fingerprint
+    tripwire and the exact re-rank."""
+    src = """
+from predictionio_tpu.retrieval.pq import PQCodebook
+
+def predict(model, q):
+    s, i = pq_scan(luts, model.pq.codes, 40)
+    s2, i2, _ = search_pq_host(model.pq, vecs, q, 10, 40)
+    t = retrieval.pq.lut_tables(model.pq, q)
+    cb = build_pq(model.item_vecs, m=8)
+    return decode_pq(model.pq)
+"""
+    violations = lint_retrieval.check_source(src, "t.py")
+    assert len(violations) == 6  # 1 import + 5 calls
+    assert any("PQCodebook" in v for v in violations)
+
+
 def test_facade_usage_is_clean():
     src = """
 from predictionio_tpu.retrieval import Retriever, cached_retriever, iter_hits

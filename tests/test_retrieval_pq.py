@@ -1,0 +1,624 @@
+"""Quantized corpora (ISSUE 13): PQ round-trips, scan parity, recall
+with exact re-rank, the fingerprint tripwire, and generation atomicity.
+
+The contract under test: PQ codes ORDER a shortlist, the exact re-rank
+DECIDES the top-k — so recall@10 ≥ 0.95 on the synthetic clustered
+corpus at defaults, every scan backend (Pallas-interpret kernel, XLA
+gather fallback, host numpy) ranks identically, and a codebook that
+does not fingerprint-match the served corpus is dropped loudly with
+exact serving continuing.  Server-level tests prove staged reload,
+canary rejection and rollback each leave index+codes+model consistent.
+CPU-only; the Pallas kernel runs in interpret mode on tiny shapes.
+"""
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from predictionio_tpu.retrieval import (
+    PQCodebook,
+    Retriever,
+    build_ivf,
+    build_pq,
+    build_train_pq,
+    corpus_fingerprint,
+)
+from predictionio_tpu.retrieval.pq import (
+    decode_pq,
+    lut_tables,
+    pq_build_config,
+    quantize_int8,
+    search_ivf_pq_host,
+    search_pq_host,
+)
+
+
+def _clustered_corpus(n=4000, d=16, n_clusters=40, seed=0, n_q=64):
+    """Well-separated direction clusters + queries near members — the
+    same shape test_retrieval.py uses for the IVF recall pin."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    assign = rng.integers(0, n_clusters, n)
+    items = centers[assign] + 0.15 * rng.normal(size=(n, d)).astype(
+        np.float32)
+    items /= np.linalg.norm(items, axis=1, keepdims=True)
+    q_src = rng.integers(0, n, n_q)
+    queries = items[q_src] + 0.05 * rng.normal(size=(n_q, d)).astype(
+        np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    return queries.astype(np.float32), items.astype(np.float32)
+
+
+def _exact_ids(queries, items, k):
+    s = queries @ items.T
+    return np.argsort(-s, axis=1, kind="stable")[:, :k]
+
+
+def _recall(ids, want, k=10):
+    hit = sum(len(set(ids[b, :k]) & set(want[b])) for b in
+              range(len(want)))
+    return hit / want.size
+
+
+# -- codebook build / encode-decode ------------------------------------------
+
+
+class TestBuild:
+    def test_encode_decode_error_bound(self):
+        """Residual PQ reconstruction beats coarse-only, and the LUT
+        score error is bounded by ||q||·||x - x̂|| per item."""
+        q, items = _clustered_corpus(n=2000)
+        pq = build_pq(items, m=4)
+        dec = decode_pq(pq)
+        assert dec.shape == items.shape
+        res_err = np.linalg.norm(items - dec, axis=1)
+        coarse_only = np.linalg.norm(
+            items - pq.coarse[pq.codes[:, 0].astype(int)], axis=1)
+        assert res_err.mean() < 0.5 * coarse_only.mean()
+        # score bound: |q·x − lut_sum| ≤ ‖q‖·‖x−x̂‖ (Cauchy-Schwarz)
+        luts = lut_tables(pq, q[:4])
+        acc = luts[:, 0, :][:, pq.codes[:, 0]]
+        for m in range(1, pq.n_tables):
+            acc = acc + luts[:, m, :][:, pq.codes[:, m]]
+        exact = q[:4] @ items.T
+        qn = np.linalg.norm(q[:4], axis=1)[:, None]
+        assert (np.abs(exact - acc) <= qn * res_err[None, :] + 1e-4).all()
+
+    def test_lut_sum_equals_q_dot_decode(self):
+        """The asymmetric LUT score of item n is EXACTLY q·decode(n)."""
+        q, items = _clustered_corpus(n=800)
+        pq = build_pq(items, m=8)
+        luts = lut_tables(pq, q[:8])
+        want = q[:8] @ decode_pq(pq).T
+        acc = luts[:, 0, :][:, pq.codes[:, 0]]
+        for m in range(1, pq.n_tables):
+            acc = acc + luts[:, m, :][:, pq.codes[:, m]]
+        np.testing.assert_allclose(acc, want, rtol=1e-4, atol=1e-4)
+
+    def test_bytes_per_item_and_m_resolution(self):
+        _, items = _clustered_corpus(n=600)
+        pq = build_pq(items, m=4)
+        assert pq.bytes_per_item() == 5       # coarse byte + 4 codes
+        assert pq.codes.dtype == np.uint8
+        # m rounds DOWN to a divisor of D (d=16: 5 → 4)
+        pq5 = build_pq(items[:300], m=5)
+        assert pq5.m == 4 and pq5.dsub == 4
+
+    def test_coarse_book_rides_ivf_centroids(self):
+        """nlist ≤ 256: the residual coarse book derives from the IVF
+        centroids — PQ sits on top of the existing coarse structure."""
+        _, items = _clustered_corpus(n=1200)
+        ivf = build_ivf(items, nlist=12, force=True)
+        pq = build_pq(items, m=4, ivf=ivf)
+        assert pq.n_coarse == 12
+        # refined but seeded from the 12 cells: assignments must cover
+        # only the real rows, never the zero padding
+        assert pq.codes[:, 0].max() < 12
+        assert (np.abs(pq.coarse[12:]) == 0).all()
+
+    def test_build_config_policy(self, monkeypatch):
+        monkeypatch.setenv("PIO_PQ_MIN_ITEMS", "1000")
+        monkeypatch.delenv("PIO_PQ", raising=False)
+        build, m, min_items = pq_build_config(999, 32)
+        assert (build, min_items) == (False, 1000)
+        # the threshold is the contract, PIO_PQ=on included
+        monkeypatch.setenv("PIO_PQ", "on")
+        assert pq_build_config(999, 32)[0] is False
+        build, m, _ = pq_build_config(1000, 32)
+        assert build and m == 8               # ~D/4
+        monkeypatch.setenv("PIO_PQ_M", "16")
+        assert pq_build_config(1000, 32)[1] == 16
+        monkeypatch.setenv("PIO_PQ_M", "junk")
+        assert pq_build_config(1000, 32)[1] == 8  # loud fallback
+        monkeypatch.setenv("PIO_PQ", "off")
+        assert pq_build_config(10 ** 7, 32)[0] is False
+
+    def test_unrecognized_pio_pq_warns_and_autos(
+            self, monkeypatch, caplog):
+        """A typo'd opt-out (PIO_PQ=0ff) must not silently build-and-
+        serve codes the operator tried to disable."""
+        import logging
+
+        monkeypatch.setenv("PIO_PQ", "0ff")
+        monkeypatch.setenv("PIO_PQ_MIN_ITEMS", "100")
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.retrieval.pq"):
+            build, m, _ = pq_build_config(1000, 32)
+        assert build  # auto semantics
+        assert any("PIO_PQ" in rec.getMessage()
+                   for rec in caplog.records)
+
+    def test_build_train_pq_seedless_is_deterministic(self, monkeypatch):
+        monkeypatch.setenv("PIO_PQ_MIN_ITEMS", "1")
+        _, items = _clustered_corpus(n=500)
+        a = build_train_pq(items, name="t")
+        b = build_train_pq(items, name="t")
+        np.testing.assert_array_equal(a.codes, b.codes)
+        np.testing.assert_array_equal(a.codebooks, b.codebooks)
+
+    def test_int8_quantize_round_trip(self):
+        _, items = _clustered_corpus(n=300)
+        q8, scale = quantize_int8(items)
+        assert q8.dtype == np.int8 and scale.shape == (300,)
+        back = q8.astype(np.float32) * scale[:, None]
+        # symmetric per-row quantization: worst error ≤ scale/2 per dim
+        assert (np.abs(back - items) <= scale[:, None] * 0.5 + 1e-7).all()
+        zero_row = quantize_int8(np.zeros((1, 4), np.float32))
+        assert (zero_row[0] == 0).all() and zero_row[1][0] == 1.0
+
+
+# -- scan parity: kernel ≡ XLA fallback ≡ host numpy -------------------------
+
+
+class TestScanParity:
+    def _luts_codes(self, n=1500, k=23):
+        q, items = _clustered_corpus(n=n, n_q=8)
+        pq = build_pq(items, m=4)
+        luts = lut_tables(pq, q)
+        codes_sn = np.ascontiguousarray(pq.codes.T)
+        acc = luts[:, 0, :][:, pq.codes[:, 0]]
+        for m in range(1, pq.n_tables):
+            acc = acc + luts[:, m, :][:, pq.codes[:, m]]
+        ref = np.argsort(-acc, axis=1, kind="stable")[:, :k]
+        return luts, codes_sn, acc, ref
+
+    def test_kernel_xla_host_agree(self):
+        from predictionio_tpu.ops.pallas_kernels import (
+            pq_scan_pallas,
+            pq_scan_xla,
+        )
+
+        luts, codes_sn, acc, ref = self._luts_codes()
+        k = ref.shape[1]
+        sk, ik = pq_scan_pallas(jnp.asarray(luts), jnp.asarray(codes_sn),
+                                k, interpret=True)
+        sx, ix = pq_scan_xla(jnp.asarray(luts), jnp.asarray(codes_sn),
+                             k, chunk=512)
+        for b in range(len(ref)):
+            assert set(np.asarray(ik)[b]) == set(ref[b])
+            assert set(np.asarray(ix)[b]) == set(ref[b])
+        want_s = np.sort(np.take_along_axis(acc, ref, 1), axis=1)
+        np.testing.assert_allclose(np.sort(np.asarray(sk), 1), want_s,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.sort(np.asarray(sx), 1), want_s,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_n_valid_masks_padding_columns(self):
+        from predictionio_tpu.ops.pallas_kernels import (
+            pq_scan_pallas,
+            pq_scan_xla,
+        )
+
+        luts, codes_sn, acc, _ = self._luts_codes(n=900)
+        for fn, kw in ((pq_scan_pallas, {"interpret": True}),
+                       (pq_scan_xla, {"chunk": 256})):
+            _, ids = fn(jnp.asarray(luts), jnp.asarray(codes_sn), 9,
+                        n_valid=700, **kw)
+            assert int(np.asarray(ids).max()) < 700
+
+    def test_device_search_matches_host(self):
+        from predictionio_tpu.retrieval.pq import (
+            search_ivf_pq_device,
+            search_pq_device,
+        )
+
+        q, items = _clustered_corpus(n=2000, n_q=8)
+        ivf = build_ivf(items, nlist=20, force=True)
+        pq = build_pq(items, m=4, ivf=ivf)
+        r = Retriever(items, ivf=ivf, pq=pq, name="t-par")
+        s, i, sc = search_pq_device(
+            pq, q, 10, 40, jit_cache={}, consts=r.pq_device_arrays(),
+            rerank_consts=r.rerank_arrays())
+        sh, ih, sch = search_pq_host(pq, items, q, 10, 40)
+        np.testing.assert_array_equal(np.sort(i, 1), np.sort(ih, 1))
+        np.testing.assert_allclose(np.sort(s, 1), np.sort(sh, 1),
+                                   rtol=1e-5, atol=1e-5)
+        assert sc == sch
+        sv, iv, scv = search_ivf_pq_device(
+            ivf, pq, q, 10, 6, 40, jit_cache={},
+            ivf_consts=r.ivf_device_arrays(),
+            pq_consts=r.pq_device_arrays(),
+            rerank_consts=r.rerank_arrays())
+        svh, ivh, scvh = search_ivf_pq_host(ivf, pq, items, q, 10, 6, 40)
+        np.testing.assert_array_equal(np.sort(iv, 1), np.sort(ivh, 1))
+        assert scv == scvh
+
+
+# -- recall with exact re-rank (acceptance) ----------------------------------
+
+
+class TestRecall:
+    def test_recall_at_10_with_rerank(self, monkeypatch):
+        """Acceptance: recall@10 ≥ 0.95 at defaults on the clustered
+        corpus, both PQ rungs, while ivf_pq scans a fraction of rows."""
+        monkeypatch.delenv("PIO_IVF_NPROBE", raising=False)
+        monkeypatch.delenv("PIO_PQ_RERANK", raising=False)
+        q, items = _clustered_corpus()
+        want = _exact_ids(q, items, 10)
+        ivf = build_ivf(items, force=True)
+        pq = build_pq(items, m=4, ivf=ivf)
+        s, i, _ = search_pq_host(pq, items, q, 10, 40)
+        assert _recall(i, want) >= 0.95
+        r = Retriever(items, ivf=ivf, pq=pq, name="t-recall")
+        p = r.plan(len(q), 10)
+        assert p.rung == "ivf_pq" and p.rerank == 40
+        scores, ids, info = r.topk(q, 10)
+        assert _recall(ids, want) >= 0.95
+        assert info["candidates"] < 0.5 * len(q) * len(items)
+        # the returned scores are EXACT inner products, not LUT scores
+        got = np.take_along_axis(q @ items.T, ids, axis=1)
+        np.testing.assert_allclose(scores, got, rtol=1e-4, atol=1e-4)
+
+    def test_rerank_knob(self, monkeypatch):
+        q, items = _clustered_corpus(n=600, n_clusters=10)
+        pq = build_pq(items, m=4)
+        r = Retriever(items, pq=pq, name="t-rr")
+        assert r.plan(1, 10).rung == "pq_flat"
+        assert r.plan(1, 10).rerank == 40            # 4·k default
+        monkeypatch.setenv("PIO_PQ_RERANK", "7")     # clamped to ≥ k
+        assert r.plan(1, 10).rerank == 10
+        monkeypatch.setenv("PIO_PQ_RERANK", "200")
+        assert r.plan(1, 10).rerank == 200
+        monkeypatch.setenv("PIO_PQ_RERANK", "junk")
+        assert r.plan(1, 10).rerank == 40            # loud fallback
+
+    def test_corpus_dtype_rerank_overlap(self, monkeypatch):
+        """bf16/int8 re-rank corpora keep the same top-10 on the
+        clustered corpus (scores shift within quantization error)."""
+        from predictionio_tpu.retrieval.pq import search_pq_device
+
+        q, items = _clustered_corpus(n=800, n_q=8)
+        pq = build_pq(items, m=4)
+        outs = {}
+        for dt in ("f32", "bf16", "int8"):
+            monkeypatch.setenv("PIO_CORPUS_DTYPE", dt)
+            r = Retriever(items, pq=pq, name=f"t-dt-{dt}")
+            _, ids, _ = search_pq_device(
+                pq, q, 10, 40, jit_cache={},
+                consts=r.pq_device_arrays(),
+                rerank_consts=r.rerank_arrays())
+            outs[dt] = ids
+        for dt in ("bf16", "int8"):
+            overlap = np.mean([
+                len(set(outs[dt][b]) & set(outs["f32"][b])) / 10
+                for b in range(len(q))])
+            assert overlap >= 0.9, (dt, overlap)
+
+    def test_unknown_corpus_dtype_warns_and_serves_f32(
+            self, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setenv("PIO_CORPUS_DTYPE", "fp4")
+        _, items = _clustered_corpus(n=300, n_clusters=5)
+        r = Retriever(items, name="t-baddt")
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.retrieval"):
+            vecs, scales = r.rerank_arrays()
+        assert scales is None
+        assert any("PIO_CORPUS_DTYPE" in rec.getMessage()
+                   for rec in caplog.records)
+
+
+# -- facade routing ----------------------------------------------------------
+
+
+class TestRouting:
+    def _pq_retriever(self, with_ivf=True, name="t-route"):
+        q, items = _clustered_corpus(n=600, n_clusters=10)
+        ivf = build_ivf(items, nlist=8, force=True) if with_ivf else None
+        pq = build_pq(items, m=4, ivf=ivf)
+        return q, items, Retriever(items, ivf=ivf, pq=pq, name=name)
+
+    def test_auto_prefers_ivf_pq_then_pq_flat(self):
+        _, _, r = self._pq_retriever(with_ivf=True, name="t-auto1")
+        assert r.plan(4, 10).rung == "ivf_pq"
+        _, _, r2 = self._pq_retriever(with_ivf=False, name="t-auto2")
+        assert r2.plan(4, 10).rung == "pq_flat"
+
+    def test_exclude_pins_exact_rung(self):
+        q, items, r = self._pq_retriever(name="t-excl")
+        assert r.plan(1, 10, has_exclude=True).rung in ("host", "device")
+        excl = np.zeros((1, len(items)), dtype=bool)
+        top = _exact_ids(q[:1], items, 1)[0, 0]
+        excl[0, top] = True
+        _, ids, info = r.topk(q[:1], 10, exclude=excl)
+        assert info["rung"] in ("host", "device")
+        assert top not in ids[0]
+
+    def test_forced_pq_without_codebook_degrades_loudly(
+            self, monkeypatch, caplog):
+        import logging
+
+        _, items = _clustered_corpus(n=300, n_clusters=5)
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "pq_flat")
+        r = Retriever(items, name="t-nopq")
+        with caplog.at_level(logging.WARNING,
+                             logger="predictionio_tpu.retrieval"):
+            p = r.plan(1, 10)
+        assert p.rung == "host"
+        assert any("pq_flat" in rec.getMessage()
+                   for rec in caplog.records)
+
+    def test_forced_ivf_pq_without_index_serves_pq_flat(
+            self, monkeypatch):
+        _, _, r = self._pq_retriever(with_ivf=False, name="t-noivf")
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "ivf_pq")
+        assert r.plan(1, 10).rung == "pq_flat"
+
+    def test_pq_rungs_agree_with_exact(self, monkeypatch):
+        q, items, r = self._pq_retriever(name="t-agree")
+        want = _exact_ids(q, items, 10)
+        for rung in ("ivf_pq", "pq_flat"):
+            monkeypatch.setenv("PIO_RETRIEVAL_RUNG", rung)
+            _, ids, info = r.topk(q[:8], 10)
+            assert info["rung"] == rung
+            assert _recall(ids, want[:8]) >= 0.95, rung
+
+
+# -- the tripwire ------------------------------------------------------------
+
+
+class TestTripwire:
+    def test_mismatched_codebook_dropped_loudly(self, pio_home):
+        """Codes from generation N next to generation N+1 vectors are
+        dropped (exact serving continues, counter increments) — results
+        are never silently wrong."""
+        from predictionio_tpu.obs import get_registry
+
+        q, items_n = _clustered_corpus(n=600, n_clusters=6, seed=1)
+        _, items_n1 = _clustered_corpus(n=600, n_clusters=6, seed=2)
+        stale = build_pq(items_n, m=4)
+        r = Retriever(items_n1, pq=stale, name="t-mix")
+        assert r.pq_codebook() is None
+        _, ids, info = r.topk(q, 10)
+        assert info["rung"] not in ("ivf_pq", "pq_flat")
+        np.testing.assert_array_equal(
+            np.sort(ids, axis=1),
+            np.sort(_exact_ids(q, items_n1, 10), axis=1))
+        c = get_registry().counter("pio_retrieval_pq_rejected_total",
+                                   "", ("corpus",))
+        assert c.value(corpus="t-mix") == 1
+
+    def test_matching_codebook_survives(self):
+        _, items = _clustered_corpus(n=600, n_clusters=6)
+        pq = build_pq(items, m=4)
+        r = Retriever(items, pq=pq, name="t-ok")
+        assert r.pq_codebook() is pq
+        assert pq.fingerprint == corpus_fingerprint(items)
+
+    def test_wrapper_pickle_carries_codes(self):
+        """Model, index and codes are ONE artifact: the pickle
+        round-trip the generation swap moves keeps them consistent."""
+        from predictionio_tpu.data.event import BiMap
+        from predictionio_tpu.templates.twotower.engine import (
+            TwoTowerModelWrapper,
+        )
+
+        _, items = _clustered_corpus(n=600, n_clusters=6)
+        w = TwoTowerModelWrapper(
+            user_vecs=np.ones((1, items.shape[1]), np.float32),
+            item_vecs=items,
+            user_index=BiMap.string_int(["u0"]),
+            item_index=BiMap.string_int(
+                [f"i{j}" for j in range(len(items))]),
+            ivf=build_ivf(items, nlist=6, force=True),
+            pq=build_pq(items, m=4))
+        w2 = pickle.loads(pickle.dumps(w))
+        assert w2.pq is not None and w2.ivf is not None
+        r = Retriever(w2.item_vecs, ivf=w2.ivf, pq=w2.pq, name="t-pkl")
+        assert r.pq_codebook() is w2.pq
+        assert r.ivf_index() is w2.ivf
+
+    def test_old_pickle_without_pq_backfills(self):
+        """A pre-ISSUE-13 wrapper pickle loads with pq=None and serves
+        exact — upgrades never require a retrain."""
+        from types import SimpleNamespace
+
+        from predictionio_tpu.data.event import BiMap
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSModelWrapper,
+        )
+
+        _, items = _clustered_corpus(n=64, d=8, n_clusters=4)
+        w = ALSModelWrapper(
+            model=SimpleNamespace(user_factors=items[:8],
+                                  item_factors=items, implicit=False),
+            user_index=BiMap({f"u{j}": j for j in range(8)}),
+            item_index=BiMap({f"i{j}": j for j in range(64)}))
+        state = w.__getstate__()
+        state.pop("pq", None)  # simulate an old generation's pickle
+        w2 = ALSModelWrapper.__new__(ALSModelWrapper)
+        w2.__setstate__(state)
+        assert getattr(w2, "pq", "missing") is None
+
+
+# -- server-level generation atomicity (acceptance) --------------------------
+
+
+def _trained_pq_server(storage, monkeypatch, n_items=64):
+    """ALS engine server with IVF+PQ forced on (tiny thresholds)."""
+    from predictionio_tpu.controller import EngineVariant, RuntimeContext
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import App
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.templates.recommendation import engine
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    monkeypatch.setenv("PIO_IVF", "on")
+    monkeypatch.setenv("PIO_IVF_MIN_ITEMS", "10")
+    monkeypatch.setenv("PIO_PQ", "on")
+    monkeypatch.setenv("PIO_PQ_MIN_ITEMS", "10")
+    ctx = RuntimeContext.create(storage=storage)
+    app_id = storage.get_apps().insert(App(id=None, name="pqapp"))
+    storage.get_events().init(app_id)
+    rng = np.random.default_rng(7)
+    storage.get_events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=f"u{u}",
+               target_entity_type="item", target_entity_id=f"i{i}",
+               properties=DataMap({"rating": float(r)}))
+         for u, i, r in zip(rng.integers(0, 30, 600),
+                            rng.integers(0, n_items, 600),
+                            rng.integers(1, 6, 600))], app_id)
+    variant = EngineVariant.from_dict({
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation:engine",
+        "datasource": {"params": {"appName": "pqapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 2}}],
+    })
+    eng = engine()
+    run_train(eng, variant, ctx)
+    srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0)
+    return srv, eng, variant, ctx, app_id
+
+
+def _assert_pq_consistent(wrapper):
+    """The served codes MUST fingerprint-match the served vectors."""
+    r = wrapper.retriever()
+    pq = r.pq_codebook()
+    idx = r.ivf_index()
+    assert pq is not None, "PQ codebook missing from serving wrapper"
+    assert idx is not None, "IVF index missing from serving wrapper"
+    host = wrapper.host_factors()[1]
+    fp = corpus_fingerprint(host)
+    assert pq.fingerprint == fp
+    assert idx.fingerprint == fp
+    return pq
+
+
+def test_reload_canary_rollback_swap_codes_with_model(
+        pio_home, monkeypatch):
+    """ISSUE 13 acceptance: staged reload, canary rejection and rollback
+    each leave index+codes+model consistent — a rollback never serves
+    generation-N vectors through generation-N+1 codes, and a rejected
+    candidate never replaces the serving generation's codes."""
+    from predictionio_tpu.data.event import DataMap, Event
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    storage = get_storage()
+    srv, eng, variant, ctx, app_id = _trained_pq_server(
+        storage, monkeypatch)
+    fp1 = _assert_pq_consistent(srv._models[0]).fingerprint
+
+    # Canary rejection: a NaN candidate model 409s and the SERVING
+    # generation (model AND codes) stays untouched.
+    from predictionio_tpu.server import engine_server as es_mod
+    from predictionio_tpu.workflow import core_workflow
+
+    real_load = core_workflow.load_models
+
+    def poisoned_load(engine, instance, c=None):
+        models = real_load(engine, instance, c)
+        m = models[0]
+        uf = np.asarray(m.model.user_factors).copy()
+        uf[0, 0] = np.nan
+        m.model.user_factors = uf
+        return models
+
+    monkeypatch.setattr(es_mod, "load_models", poisoned_load)
+    st, body = srv.handle("POST", "/reload", b"")
+    assert st == 409, body
+    monkeypatch.setattr(es_mod, "load_models", real_load)
+    assert _assert_pq_consistent(srv._models[0]).fingerprint == fp1
+
+    # Generation 2: more events → new factors → NEW fingerprint; the
+    # reload carries its OWN codes.
+    rng = np.random.default_rng(11)
+    storage.get_events().insert_batch(
+        [Event(event="rate", entity_type="user", entity_id=f"u{u}",
+               target_entity_type="item", target_entity_id=f"i{i}",
+               properties=DataMap({"rating": float(r)}))
+         for u, i, r in zip(rng.integers(0, 30, 200),
+                            rng.integers(0, 64, 200),
+                            rng.integers(1, 6, 200))], app_id)
+    run_train(eng, variant, ctx)
+    st, body = srv.handle("POST", "/reload", b"")
+    assert st == 200
+    fp2 = _assert_pq_consistent(srv._models[0]).fingerprint
+    assert fp2 != fp1
+
+    # Rollback: generation 1's model AND generation 1's codes return
+    # together, and it serves through the quantized rung.
+    st, body = srv.handle("POST", "/admin/rollback", b"")
+    assert st == 200
+    assert _assert_pq_consistent(srv._models[0]).fingerprint == fp1
+    monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "ivf_pq")
+    st, body = srv.handle("POST", "/queries.json",
+                          b'{"user": "u1", "num": 3}')
+    assert st == 200 and body["itemScores"]
+
+
+def test_pq_rides_train_and_serves(pio_home, monkeypatch):
+    """End-to-end: `pio train` builds codes under the env policy,
+    serving auto-routes the quantized rung, results match exact."""
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    srv, *_ = _trained_pq_server(storage, monkeypatch)
+    w = srv._models[0]
+    _assert_pq_consistent(w)
+    # auto routing picks ivf_pq (codebook + index both valid)
+    assert w.retriever().plan(4, 5).rung == "ivf_pq"
+    st, body = srv.handle("POST", "/queries.json",
+                          b'{"user": "u2", "num": 5}')
+    assert st == 200 and len(body["itemScores"]) == 5
+    # the answered scores are exact reconstructions, not LUT scores
+    uf, itf = w.host_factors()
+    exact = uf[w.user_index["u2"]] @ itf.T
+    for hit in body["itemScores"]:
+        col = w.item_index[hit["item"]]
+        np.testing.assert_allclose(hit["score"], exact[col], rtol=1e-3)
+
+
+def test_fingerprint_mismatch_serves_exact_on_live_server(
+        pio_home, monkeypatch):
+    """Acceptance: a mismatched codebook on a LIVE server degrades to
+    exact serving with the counter incremented — never silently wrong
+    results, never a 5xx."""
+    from predictionio_tpu.data.storage import get_storage
+    from predictionio_tpu.obs import get_registry
+
+    storage = get_storage()
+    srv, *_ = _trained_pq_server(storage, monkeypatch)
+    w = srv._models[0]
+    _, other = _clustered_corpus(n=len(w.item_index),
+                                 d=w.model.item_factors.shape[1],
+                                 n_clusters=6, seed=9)
+    w.pq = build_pq(other, m=2)       # stale codes, wrong fingerprint
+    w.ivf = None
+    st, body = srv.handle("POST", "/queries.json",
+                          b'{"user": "u2", "num": 5}')
+    assert st == 200 and len(body["itemScores"]) == 5
+    c = get_registry().counter("pio_retrieval_pq_rejected_total",
+                               "", ("corpus",))
+    assert c.value(corpus="als") == 1
+    uf, itf = w.host_factors()
+    exact = uf[w.user_index["u2"]] @ itf.T
+    want = set(np.argsort(-exact)[:5])
+    got = {w.item_index[h["item"]] for h in body["itemScores"]}
+    assert got == want

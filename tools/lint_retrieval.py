@@ -44,13 +44,23 @@ from typing import List
 # Directories whose modules are the serving surface (rule scope).
 _SCOPES = ("templates", "server", "serving")
 # Modules that are facade internals — importing them from the serving
-# surface is rule 1's violation.
+# surface is rule 1's violation.  ``retrieval.pq`` joins the list in
+# ISSUE 13: codebooks, LUT builders and PQ searches are reachable only
+# through the facade (``Retriever.topk`` / ``build_train_pq``), so the
+# fingerprint tripwire and re-rank policy can never be side-stepped.
 _BANNED_MODULES = ("predictionio_tpu.ops.topk",
-                   "predictionio_tpu.ops.pallas_kernels")
+                   "predictionio_tpu.ops.pallas_kernels",
+                   "predictionio_tpu.retrieval.pq")
 # The retrieval primitives themselves (rule 2) — any call spelled
-# ``name(...)`` or ``<anything>.name(...)``.
+# ``name(...)`` or ``<anything>.name(...)``.  The PQ set covers the
+# kernel, both search flavors, codebook construction and raw
+# codebook/LUT access.
 _PRIMITIVES = {"top_k_scores", "chunked_top_k", "sharded_top_k",
-               "host_top_k", "fused_topk", "fused_topk_pallas"}
+               "host_top_k", "fused_topk", "fused_topk_pallas",
+               "pq_scan", "pq_scan_pallas", "pq_scan_xla",
+               "search_pq_host", "search_pq_device",
+               "search_ivf_pq_host", "search_ivf_pq_device",
+               "build_pq", "lut_tables", "decode_pq"}
 
 
 def _import_violations(tree: ast.AST, filename: str) -> List[str]:

@@ -51,6 +51,10 @@ _LOOP_FN = "_train_attempt"
 # Files that MUST define a prefetched _train_attempt (a rename would
 # otherwise silently drop them out of rule 1's reach).
 _REQUIRED = ("two_tower.py", "dlrm.py")
+# Staging entry points that must construct a DevicePrefetcher even
+# though they are not step loops (ISSUE 13 satellite: ALS bucket
+# staging rides the SHARED input path, not a private transfer loop).
+_STAGING_FNS = {"als.py": "_device_buckets"}
 # Host→device staging primitives banned from step-loop bodies.
 _BANNED_ATTRS = {"asarray", "array", "device_put"}
 _BANNED_NAMES = {"put_sharded", "device_put"}
@@ -166,13 +170,32 @@ def _nested_function_nodes(fn: ast.AST) -> set:
 
 
 def check_source(source: str, filename: str,
-                 require_prefetcher: bool = False) -> List[str]:
+                 require_prefetcher: bool = False,
+                 require_staging_fn: str = "") -> List[str]:
     """Violations in one module's source (path:line prefixed strings)."""
     violations: List[str] = []
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as e:
         return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    if require_staging_fn:
+        # Rule 5: named staging entry points ride the shared input path.
+        staging = [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n.name == require_staging_fn]
+        if not staging:
+            violations.append(
+                f"{filename}:1: no {require_staging_fn} function — the "
+                f"shared-staging convention (and this lint's coverage) "
+                f"requires one")
+        for fn in staging:
+            if not _constructs_prefetcher(fn):
+                violations.append(
+                    f"{filename}:{fn.lineno}: {fn.name} does not "
+                    f"construct a DevicePrefetcher — bucket staging must "
+                    f"ride the shared input path (data/prefetch.py) so "
+                    f"prefetch metrics and overlap cover it, not a "
+                    f"private transfer loop")
     # Rule 3: host syncs inside lax.scan bodies (anywhere in the module).
     for body in _scan_bodies(tree):
         for sub in ast.walk(body):
@@ -248,7 +271,8 @@ def check(root: Path | str | None = None) -> List[str]:
     for path in sorted(models_dir.glob("*.py")):
         violations.extend(check_source(
             path.read_text(encoding="utf-8"), str(path),
-            require_prefetcher=path.name in _REQUIRED))
+            require_prefetcher=path.name in _REQUIRED,
+            require_staging_fn=_STAGING_FNS.get(path.name, "")))
     return violations
 
 

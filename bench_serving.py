@@ -1281,6 +1281,169 @@ def _quality_round(args) -> None:
         print(f"wrote {args.out}")
 
 
+def _fleet_rollout_round(args) -> None:
+    """ISSUE 15 round: 3 live engine instances behind a wave rollout,
+    with a BAD candidate generation injected at model load — wave 1
+    promotes the canary, its availability burn trips the fleet gate,
+    the controller halts and rolls the canary back.  Measured claims:
+    (a) detection→fleet-restored wall (bad generation serving → every
+    instance verified back on the pre-promotion generation), and (b)
+    zero non-2xx on the NOT-yet-promoted instances for the whole
+    episode, attested client-side per instance.
+
+    Single-process caveat (same shape as the PR-9 fleet e2e and the
+    PR-11 quality bench): the three servers share one metrics registry,
+    so the burn the gate reads is process-global — the per-instance
+    isolation claim rests on the CLIENT-side per-instance status
+    counts, which are independent by construction."""
+    import urllib.request as ur
+
+    from predictionio_tpu.fleet import RolloutConfig, RolloutController
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.server import engine_server as es_mod
+    from predictionio_tpu.controller import RuntimeContext
+    from predictionio_tpu.workflow.core_workflow import run_train
+
+    eng, variant, storage, n_users = _setup("als")
+    ctx = RuntimeContext.create(storage=storage)
+    servers = [EngineServer(eng, variant, storage, host="127.0.0.1",
+                            port=0) for _ in range(3)]
+    for s in servers:
+        s.start()
+    urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+    gen_before = {u: json.loads(ur.urlopen(u + "/", timeout=10).read())
+                  ["engineInstanceId"] for u in urls}
+
+    # The bad candidate: a real COMPLETED train whose LOAD is poisoned —
+    # validation passes (no non-finite arrays to reject), every predict
+    # 500s.  Only the canary instance ever loads it.
+    bad_iid = run_train(eng, variant, ctx)
+    real_load = es_mod.load_models
+
+    class _Poisoned:
+        """No arrays (finite-validation passes), no serving surface."""
+
+    def poisoned(engine_, instance, c=None):
+        if instance.id == bad_iid:
+            return [_Poisoned()]
+        return real_load(engine_, instance, c)
+
+    es_mod.load_models = poisoned
+
+    # Per-instance closed-loop drivers: statuses counted independently
+    # per instance — THE isolation attestation.
+    stop = threading.Event()
+    per_instance = {u: {} for u in urls}
+
+    def drive(url):
+        rng = np.random.default_rng(hash(url) % 2**32)
+        counts = per_instance[url]
+        while not stop.is_set():
+            body = json.dumps({"user": f"u{rng.integers(0, n_users)}",
+                               "num": 5}).encode()
+            req = ur.Request(url + "/queries.json", data=body,
+                             headers={"Content-Type":
+                                      "application/json"})
+            try:
+                with ur.urlopen(req, timeout=30) as resp:
+                    st = resp.status
+            except urllib.error.HTTPError as e:
+                st = e.code
+            except OSError:
+                st = -1
+            counts[st] = counts.get(st, 0) + 1
+
+    drivers = [threading.Thread(target=drive, args=(u,), daemon=True)
+               for u in urls for _ in range(2)]
+    for t in drivers:
+        t.start()
+    time.sleep(1.0)  # steady state before the wave
+
+    marks = {}
+
+    class Timed(RolloutController):
+        def _promote_instance(self, url, target):
+            out = super()._promote_instance(url, target)
+            if out[0] == "ok" and "promoted" not in marks:
+                marks["promoted"] = time.perf_counter()
+                marks["canary"] = url
+            return out
+
+        def fleet_tripped(self):
+            tripped, reason = super().fleet_tripped()
+            if tripped and "tripped" not in marks:
+                marks["tripped"] = time.perf_counter()
+            return tripped, reason
+
+        def _rollback_instance(self, url):
+            out = super()._rollback_instance(url)
+            if out[0] == "ok":
+                marks["rolled_back"] = time.perf_counter()
+            return out
+
+    cfg = RolloutConfig(
+        waves="1,100%", bake_s=60.0, poll_s=0.25,
+        state_path=os.path.join(os.environ["PIO_HOME"], "rollout.json"))
+    ctl = Timed(urls, cfg)
+    state = ctl.run(bad_iid)
+    # fleet-restored: every instance verified back on its pre-promotion
+    # generation (the canary's rollback swap already landed; this is the
+    # read-back proof, part of the measured restore wall)
+    for u in urls:
+        assert ctl.served_instance(u) == gen_before[u], u
+    marks["restored"] = time.perf_counter()
+    time.sleep(0.5)  # post-restore drive tail on the restored fleet
+    stop.set()
+    for t in drivers:
+        t.join(10)
+    es_mod.load_models = real_load
+    for s in servers:
+        s.stop()
+
+    canary = marks.get("canary")
+    others = [u for u in urls if u != canary]
+    non2xx_not_promoted = {
+        u: sum(n for st, n in per_instance[u].items()
+               if not (200 <= st < 300)) for u in others}
+    record = {
+        "mode": "fleet-rollout",
+        "engine": "als",
+        "instances": len(urls),
+        "waves": cfg.waves,
+        "gate_poll_s": cfg.poll_s,
+        "injection": "candidate load poisoned on the canary only: "
+                     "validation-clean model object with no serving "
+                     "surface — every predict 500s",
+        "rollout_status": state["status"],
+        "halt_reason": state.get("haltReason"),
+        "promoted_before_halt": state.get("promoted"),
+        "rolled_back": state.get("rolledBack"),
+        "detect_s_promote_to_gate_trip": (
+            round(marks["tripped"] - marks["promoted"], 3)
+            if "tripped" in marks and "promoted" in marks else None),
+        "detect_to_fleet_restored_s": (
+            round(marks["restored"] - marks["promoted"], 3)
+            if "restored" in marks and "promoted" in marks else None),
+        "per_instance_statuses": {
+            u: {str(k): v for k, v in sorted(c.items())}
+            for u, c in per_instance.items()},
+        "canary_instance": canary,
+        "non_2xx_on_not_yet_promoted_instances": non2xx_not_promoted,
+        "zero_non_2xx_attested": all(v == 0 for v in
+                                     non2xx_not_promoted.values()),
+        "caveat": "single-process bench: one shared metrics registry "
+                  "behind all three servers, so the SLO burn the gate "
+                  "scrapes is process-global; per-instance isolation "
+                  "is attested by the independent client-side status "
+                  "counts above",
+    }
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.out}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -1320,10 +1483,21 @@ def main():
                          "driven drift→rollback episode (score-shifted "
                          "candidate promoted under load, detected by "
                          "the PSI gate, rolled back with zero non-2xx)")
+    ap.add_argument("--fleet-rollout", dest="fleet_rollout",
+                    action="store_true",
+                    help="ISSUE 15 round: 3 live instances, a wave "
+                         "rollout promotes an injected bad generation "
+                         "to the canary, the fleet gate halts and "
+                         "restores everyone — detection-to-restored "
+                         "wall + zero non-2xx attested on the "
+                         "not-yet-promoted instances")
     ap.add_argument("--out", default=None,
                     help="write the corpus-scale record to this JSON file")
     args = ap.parse_args()
 
+    if args.fleet_rollout:
+        _fleet_rollout_round(args)
+        return
     if args.quality:
         _quality_round(args)
         return

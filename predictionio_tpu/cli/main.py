@@ -137,6 +137,47 @@ def _print_fleet_status(fleet_arg: Optional[str]) -> None:
     for key, row in sorted(q.items()):
         print(f"  fleet {key}: p50 {row['p50']:g}ms p99 {row['p99']:g}ms "
               f"over {row['count']:g} requests")
+    _print_fleet_plane(doc)
+
+
+def _print_fleet_plane(doc) -> None:
+    """ISSUE 15 lines for `pio status --fleet`: shared spill-queue depth
+    (scraped gauges first, storage second) and the journaled rollout
+    wave state."""
+    gauges = doc["merged"].get("gauges", {})
+    shared = {k: v for k, v in gauges.items()
+              if k.startswith("pio_spill_shared_depth")}
+    if shared:
+        print(f"  shared spill queue: {max(shared.values()):g} event(s) "
+              "pending/leased (per-instance view of one fleet queue)")
+    else:
+        # No event server in the scraped set — best-effort direct read
+        # of THIS process's configured storage.
+        try:
+            from predictionio_tpu.resilience.shared_spill import (
+                SharedSpillQueue,
+            )
+
+            st = SharedSpillQueue(_storage()).stats()
+            print(f"  shared spill queue: {st.get('pendingEvents', 0)} "
+                  f"pending / {st.get('leasedEvents', 0)} leased / "
+                  f"{st.get('deadEvents', 0)} dead event(s)")
+        except Exception:
+            pass
+    try:
+        from predictionio_tpu.fleet import rollout_state_path
+
+        state = json.loads(rollout_state_path().read_text())
+    except Exception:
+        return
+    line = (f"  rollout [{state.get('rolloutId')}]: "
+            f"{state.get('status')} — wave {state.get('wave')} of "
+            f"{len(state.get('waveCounts') or [])}, "
+            f"{len(state.get('promoted') or [])} promoted, "
+            f"{len(state.get('skipped') or {})} skipped")
+    if state.get("haltReason"):
+        line += f", halt: {state['haltReason']}"
+    print(line)
 
 
 def _print_device_memory() -> None:
@@ -673,19 +714,35 @@ def _train_follow(args, engine, variant, ctx) -> int:
 def cmd_eval(args) -> int:
     from predictionio_tpu.controller import load_engine_factory, RuntimeContext
     from predictionio_tpu.parallel.distributed import initialize_distributed
+    from predictionio_tpu.resilience.supervision import (
+        PREEMPTED_EXIT_CODE,
+        TrainPreempted,
+        install_preemption_handler,
+    )
     from predictionio_tpu.workflow import run_evaluation
 
     initialize_distributed()
+    # Same preemption contract as training (ISSUE 15 satellite): SIGTERM
+    # checkpoints the sweep at the current (candidate, fold) boundary and
+    # exits 143; rerunning the same command resumes.
+    install_preemption_handler()
     evaluation = load_engine_factory(args.evaluation_class)()
     generator = load_engine_factory(args.params_generator_class)()
     ctx = RuntimeContext.create(seed=args.seed, mesh_spec=args.mesh)
-    instance_id, result = run_evaluation(
-        evaluation,
-        generator,
-        ctx,
-        evaluation_class=args.evaluation_class,
-        params_generator_class=args.params_generator_class,
-    )
+    try:
+        instance_id, result = run_evaluation(
+            evaluation,
+            generator,
+            ctx,
+            evaluation_class=args.evaluation_class,
+            params_generator_class=args.params_generator_class,
+            checkpoint_dir=getattr(args, "checkpoint_dir", None),
+        )
+    except TrainPreempted as e:
+        print(f"[preempted] {e}", file=sys.stderr)
+        print("[preempted] rerun the same `pio eval` command to resume "
+              "from the checkpointed folds.", file=sys.stderr)
+        return PREEMPTED_EXIT_CODE
     print(result.summary())
     print(f"Evaluation instance ID: {instance_id}")
     if args.output_json:
@@ -1100,6 +1157,62 @@ def cmd_dashboard(args) -> int:
 
 
 # --------------------------------------------------------------------------
+# pio rollout — coordinated wave promotion across a fleet (ISSUE 15)
+# --------------------------------------------------------------------------
+
+def cmd_rollout(args) -> int:
+    from predictionio_tpu.fleet import RolloutConfig, RolloutController
+    from predictionio_tpu.obs.fleet import fleet_instances_from_env
+
+    urls = ([u.strip().rstrip("/") for u in args.instances.split(",")
+             if u.strip()] if args.instances
+            else fleet_instances_from_env())
+    if not urls:
+        _die("no instances (--instances URL,URL or PIO_FLEET_INSTANCES)")
+    cfg = RolloutConfig.from_env(
+        waves=args.waves, bake_s=args.bake_s, poll_s=args.poll_s,
+        state_path=args.state)
+    ctl = RolloutController(urls, cfg)
+    if args.resume or args.unwind:
+        try:
+            state = ctl.resume(unwind=args.unwind)
+        except RuntimeError as e:
+            _die(str(e))
+    else:
+        prior = ctl.load_state()
+        if prior and prior.get("status") in ("in_progress",
+                                             "rolling_back"):
+            _die(f"rollout {prior.get('rolloutId')} is journaled "
+                 f"{prior.get('status')!r} at {ctl.state_path} — finish "
+                 "it first (--resume to continue, --unwind to roll it "
+                 "back)")
+        state = ctl.run(args.engine_instance_id)
+    print(f"rollout {state.get('rolloutId')}: {state['status']} "
+          f"(target instance {state.get('target')})")
+    print(f"  promoted: {len(state.get('promoted', []))}/"
+          f"{len(state.get('instances', []))} instance(s)"
+          + (f" through wave {state.get('wave')}"
+             if state.get('wave') is not None else ""))
+    for url, why in (state.get("skipped") or {}).items():
+        print(f"  skipped {url}: {why}")
+    if state.get("haltReason"):
+        print(f"  halt: {state['haltReason']}")
+    for url in state.get("rolledBack", []):
+        print(f"  rolled back {url}")
+    for url, why in (state.get("unwindFailures") or {}).items():
+        print(f"  UNWIND FAILED {url}: {why} — roll this instance back "
+              "by hand (POST /admin/rollback)")
+    print(f"  state journal: {ctl.state_path}")
+    # An explicitly requested unwind that rolled every instance back IS
+    # the success case; a rollout (or resumed rollout) that got halted
+    # and rolled back is not.
+    ok = state["status"] == "promoted" or (
+        args.unwind and state["status"] == "rolled_back"
+        and not state.get("unwindFailures"))
+    return 0 if ok else 1
+
+
+# --------------------------------------------------------------------------
 # pio spill — manual spill-journal operations (ISSUE 4 satellite: the
 # stopgap for ROADMAP resilience follow-on (b) until shared-queue spill)
 # --------------------------------------------------------------------------
@@ -1114,9 +1227,50 @@ def _spill_dir(args) -> "Path":
     return d
 
 
+def _spill_cli_backend(args) -> str:
+    """local|shared for the spill verbs: --backend > PIO_SPILL_BACKEND >
+    auto (shared only on a pioserver EVENTDATA source)."""
+    from predictionio_tpu.resilience.shared_spill import (
+        resolve_spill_backend,
+    )
+
+    try:
+        ev_type = _storage().config.source_for("EVENTDATA").type
+    except Exception:
+        ev_type = None
+    return resolve_spill_backend(getattr(args, "backend", None), ev_type)
+
+
+def _shared_spill(args):
+    from predictionio_tpu.data.storage import StorageError
+    from predictionio_tpu.resilience.shared_spill import SharedSpillQueue
+
+    try:
+        storage = _storage()
+        storage.get_spill_queues()  # probe support
+    except StorageError as e:
+        _die(f"shared spill queue unavailable on this storage: {e}")
+    return SharedSpillQueue(storage)
+
+
 def cmd_spill_inspect(args) -> int:
     from predictionio_tpu.resilience.spill import journal_summary
 
+    if _spill_cli_backend(args) == "shared":
+        q = _shared_spill(args)
+        st = q.stats()
+        print(f"shared spill queue [{q.queue}] "
+              f"(storage-backed, fleet-wide):")
+        print(f"  pending: {st.get('pending', 0)} record(s) / "
+              f"{st.get('pendingEvents', 0)} event(s)")
+        print(f"  leased: {st.get('leased', 0)} record(s) "
+              f"({st.get('expired', 0)} with expired leases awaiting "
+              "takeover)")
+        print(f"  dead-lettered: {st.get('dead', 0)} record(s) / "
+              f"{st.get('deadEvents', 0)} event(s)")
+        if args.json:
+            print(json.dumps(st))
+        return 0
     s = journal_summary(_spill_dir(args))
     print(f"spill journal: {s['dir']}")
     print(f"  pending: {s['pendingRecords']} record(s) / "
@@ -1143,25 +1297,49 @@ def _open_spill_exclusive(args):
         _die(str(e))
 
 
-def cmd_spill_drain(args) -> int:
-    """Foreground replay of the pending journal into storage — the same
-    record-at-a-time, token-pinned insert the event server's background
-    worker does, for when that server is gone (crashed box, decommission)
-    but its journal must not be."""
+def _spill_insert_fn(storage):
+    """One journal/queue record → storage, token-pinned (shared by both
+    drain backends)."""
     from predictionio_tpu.data.json_support import event_from_json
     from predictionio_tpu.resilience import idempotency_key
-    from predictionio_tpu.resilience.spill import ReplayWorker
-
-    journal = _open_spill_exclusive(args)
-    storage = _storage()
 
     def insert(record):
         evs = [event_from_json(e) for e in record["events"]]
         with idempotency_key(record["token"]):
             storage.get_events().insert_batch(evs, record["appId"],
                                               record.get("channelId"))
+    return insert
 
-    worker = ReplayWorker(journal, insert, batch=args.batch)
+
+def cmd_spill_drain(args) -> int:
+    """Foreground replay into storage — the same record-at-a-time,
+    token-pinned insert the event server's background worker does, for
+    when that server is gone (crashed box, decommission) but its spill
+    must not be.  Against the shared queue this is just another lease
+    drainer (safe next to live instances — leases serialize the work);
+    against the local journal it takes the exclusive flock."""
+    from predictionio_tpu.resilience.spill import ReplayWorker
+
+    storage = _storage()
+    if _spill_cli_backend(args) == "shared":
+        from predictionio_tpu.resilience.shared_spill import LeaseDrainer
+
+        q = _shared_spill(args)
+        # owner=None → LeaseDrainer mints a pid+uuid identity: two
+        # operators draining concurrently must never share an owner, or
+        # one's dead_letter could park a record the other just landed.
+        drainer = LeaseDrainer(q, _spill_insert_fn(storage),
+                               batch=args.batch)
+        landed = drainer.drain_once()
+        remaining = q.depth()
+        print(f"Replayed {landed} event(s); {remaining} still pending "
+              "in the shared queue"
+              + (" (storage unavailable or leased elsewhere — re-run "
+                 "after recovery)." if remaining else "."))
+        return 0 if remaining == 0 else 1
+    journal = _open_spill_exclusive(args)
+    worker = ReplayWorker(journal, _spill_insert_fn(storage),
+                          batch=args.batch)
     try:
         landed = worker.drain_once()
         remaining = journal.depth()
@@ -1174,6 +1352,14 @@ def cmd_spill_drain(args) -> int:
 
 
 def cmd_spill_requeue_dead(args) -> int:
+    if _spill_cli_backend(args) == "shared":
+        n = _shared_spill(args).requeue_dead()
+        if n == 0:
+            print("No dead-lettered records in the shared queue.")
+        else:
+            print(f"Requeued {n} dead-lettered event(s) — any instance's "
+                  "drainer (or `pio spill drain`) replays them.")
+        return 0
     journal = _open_spill_exclusive(args)
     try:
         n = journal.requeue_dead()
@@ -1421,6 +1607,11 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--seed", type=int, default=0)
     e.add_argument("--mesh", default=None, metavar="SPEC")
     e.add_argument("--output-json", dest="output_json")
+    e.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None,
+                   help="persist each completed (candidate, fold) unit "
+                        "here so a SIGTERM'd sweep resumes instead of "
+                        "restarting (default env PIO_EVAL_CHECKPOINT_DIR;"
+                        " cleared when the sweep completes)")
     e.set_defaults(fn=cmd_eval)
 
     es = sub.add_parser("eventserver", help="start the event ingestion server")
@@ -1524,28 +1715,78 @@ def build_parser() -> argparse.ArgumentParser:
                          "(GET /admin/profile/artifact)")
     pf.set_defaults(fn=cmd_profile)
 
+    ro = sub.add_parser("rollout",
+                        help="promote a generation across a fleet in "
+                             "gated waves, rolling the WHOLE fleet back "
+                             "on degradation")
+    ro.add_argument("--instances", default=None, metavar="URLS",
+                    help="comma-separated engine-server base URLs "
+                         "(default: PIO_FLEET_INSTANCES)")
+    ro.add_argument("--engine-instance-id", dest="engine_instance_id",
+                    default=None,
+                    help="candidate engine instance id (default: the "
+                         "first promoted server's latest COMPLETED, "
+                         "then pinned fleet-wide)")
+    ro.add_argument("--waves", default=None, metavar="SPEC",
+                    help="wave tranches, counts or percentages "
+                         "(default env PIO_ROLLOUT_WAVES, else "
+                         "'1,25%%,100%%')")
+    ro.add_argument("--bake-s", dest="bake_s", type=float, default=None,
+                    help="per-wave observation window watching the "
+                         "fleet-merged SLO burn + quality gate "
+                         "(default env PIO_ROLLOUT_BAKE_S, else 10)")
+    ro.add_argument("--poll-s", dest="poll_s", type=float, default=None,
+                    help="gate poll cadence inside the bake (default "
+                         "env PIO_ROLLOUT_POLL_S, else 1)")
+    ro.add_argument("--state", default=None, metavar="FILE",
+                    help="wave-state journal (default env "
+                         "PIO_ROLLOUT_STATE, else "
+                         "$PIO_HOME/rollout/state.json)")
+    ro.add_argument("--resume", action="store_true",
+                    help="continue a preempted rollout from its journal "
+                         "(re-verifies what each instance serves first)")
+    ro.add_argument("--unwind", action="store_true",
+                    help="roll back everything the journaled rollout "
+                         "already promoted, instead of continuing")
+    ro.set_defaults(fn=cmd_rollout)
+
     sp = sub.add_parser("spill", help="inspect/drain the storage-outage "
                                       "spill journal")
     spsub = sp.add_subparsers(dest="spill_verb", required=True)
     si = spsub.add_parser("inspect", help="pending/dead-letter counts "
                                           "(read-only; safe while the "
                                           "event server runs)")
+    _backend_help = ("spill home to operate on: 'shared' = the "
+                     "storage-backed fleet queue, 'local' = this box's "
+                     "JSONL journal (default: PIO_SPILL_BACKEND, else "
+                     "auto — shared on a pioserver EVENTDATA source)")
     si.add_argument("--dir", default=None,
                     help="journal directory (default: PIO_SPILL_DIR, "
                          "else $PIO_HOME/spill)")
+    si.add_argument("--backend", default=None,
+                    choices=("auto", "local", "shared"),
+                    help=_backend_help)
     si.add_argument("--json", action="store_true",
                     help="also print the summary as one JSON line")
     si.set_defaults(fn=cmd_spill_inspect)
     sd = spsub.add_parser("drain", help="foreground replay into storage "
-                                        "(event server must be stopped)")
+                                        "(local: event server must be "
+                                        "stopped; shared: safe anytime — "
+                                        "leases serialize)")
     sd.add_argument("--dir", default=None)
+    sd.add_argument("--backend", default=None,
+                    choices=("auto", "local", "shared"),
+                    help=_backend_help)
     sd.add_argument("--batch", type=int, default=100,
                     help="records per replay batch")
     sd.set_defaults(fn=cmd_spill_drain)
     sq = spsub.add_parser("requeue-dead",
                           help="move dead-lettered records back into the "
-                               "journal for replay")
+                               "queue/journal for replay")
     sq.add_argument("--dir", default=None)
+    sq.add_argument("--backend", default=None,
+                    choices=("auto", "local", "shared"),
+                    help=_backend_help)
     sq.set_defaults(fn=cmd_spill_requeue_dead)
 
     imp = sub.add_parser("import", help="import NDJSON events")

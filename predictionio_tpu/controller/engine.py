@@ -35,7 +35,53 @@ from predictionio_tpu.controller.params import (
     params_to_dict,
 )
 
-__all__ = ["Engine", "EngineParams", "EngineVariant", "load_engine_factory"]
+__all__ = ["Engine", "EngineParams", "EngineVariant", "EvalCheckpoint",
+           "load_engine_factory"]
+
+
+class EvalCheckpoint:
+    """Fold-granular eval-sweep checkpoints (ISSUE 15 satellite, carried
+    since PR 7's eval rewire).
+
+    ``pio eval`` sweeps are candidates × folds of full trains; a
+    SIGTERM'd sweep used to restart from scratch.  One completed
+    ``(candidate, fold)`` unit = one pickle file in ``directory``; on
+    resume :meth:`Engine.eval_multi` loads completed units instead of
+    retraining them.  Validity rests on the same determinism contract as
+    train resume: the SAME evaluation command (same candidates, same
+    seeds) produces the same fold split, so unit (ci, fi) means the same
+    work across runs — a changed sweep should use a fresh directory."""
+
+    def __init__(self, directory):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, candidate: int, fold: int) -> Path:
+        return self.dir / f"cand{candidate:04d}_fold{fold:04d}.pkl"
+
+    def has(self, candidate: int, fold: int) -> bool:
+        return self._path(candidate, fold).exists()
+
+    def get(self, candidate: int, fold: int):
+        import pickle
+
+        with open(self._path(candidate, fold), "rb") as f:
+            return pickle.load(f)
+
+    def put(self, candidate: int, fold: int, result) -> None:
+        import pickle
+
+        tmp = self._path(candidate, fold).with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(result, f)
+        tmp.replace(self._path(candidate, fold))  # atomic: never torn
+
+    def completed(self) -> int:
+        return len(list(self.dir.glob("cand*_fold*.pkl")))
+
+    def clear(self) -> None:
+        for p in self.dir.glob("cand*_fold*.pkl"):
+            p.unlink(missing_ok=True)
 
 
 @dataclasses.dataclass
@@ -194,7 +240,9 @@ class Engine:
         return self.eval_multi(ctx, [engine_params])[0]
 
     def eval_multi(
-        self, ctx: RuntimeContext, engine_params_list: Sequence[EngineParams]
+        self, ctx: RuntimeContext,
+        engine_params_list: Sequence[EngineParams],
+        checkpoint: Optional["EvalCheckpoint"] = None,
     ) -> List[List[Tuple[Any, List[Tuple[Any, Any, Any]]]]]:
         """Shared-prep candidate sweep (round-2 verdict item 9).
 
@@ -204,7 +252,21 @@ class Engine:
         plus N algorithm trains.  Compiled-program reuse across
         candidates is free on top: identical fold shapes hit the jit
         cache.  Returns per-candidate results aligned with the input.
+
+        With ``checkpoint`` (ISSUE 15 satellite) every completed
+        ``(candidate, fold)`` unit is persisted as it finishes, a
+        pending SIGTERM raises
+        :class:`~predictionio_tpu.resilience.supervision.TrainPreempted`
+        BETWEEN units (a preemption inside a supervised ``train()``
+        propagates the same way), and a rerun loads completed units
+        instead of retraining them — the training preemption contract,
+        extended to eval sweeps.
         """
+        from predictionio_tpu.resilience.supervision import (
+            TrainPreempted,
+            preemption_requested,
+        )
+
         results: List[Any] = [None] * len(engine_params_list)
         groups: Dict[str, List[int]] = {}
         for i, ep in enumerate(engine_params_list):
@@ -219,9 +281,27 @@ class Engine:
             # Fold OUTER, candidates inner: only ONE prepared fold is live
             # at a time (the old per-candidate eval held one fold too —
             # holding all K at once would be a memory regression).
-            for td, eval_info, qa in datasource.read_eval(ctx):
-                pd = preparator.prepare(ctx, td)
+            for fi, (td, eval_info, qa) in enumerate(
+                    datasource.read_eval(ctx)):
+                todo = [ci for ci in idxs
+                        if checkpoint is None
+                        or not checkpoint.has(ci, fi)]
+                # Skip the fold's prepare entirely when a prior run
+                # already finished every candidate on it.
+                pd = preparator.prepare(ctx, td) if todo else None
                 for ci in idxs:
+                    if ci not in todo:
+                        results[ci].append(checkpoint.get(ci, fi))
+                        continue
+                    if checkpoint is not None and preemption_requested():
+                        # fn/step carry the sweep coordinates; the
+                        # "checkpointed" flag is honest — every finished
+                        # unit is already on disk.
+                        raise TrainPreempted(
+                            f"eval sweep (candidate {ci} fold {fi}, "
+                            f"{checkpoint.completed()} unit(s) saved)",
+                            step=fi,
+                            checkpointed=checkpoint.completed() > 0)
                     engine_params = engine_params_list[ci]
                     serving = self.make_serving(engine_params)
                     algos = self.make_algorithms(engine_params)
@@ -237,6 +317,8 @@ class Engine:
                         qpa.append((q, serving.serve(q, predictions),
                                     actual))
                     results[ci].append((eval_info, qpa))
+                    if checkpoint is not None:
+                        checkpoint.put(ci, fi, (eval_info, qpa))
         return results
 
 

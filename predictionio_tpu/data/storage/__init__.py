@@ -26,15 +26,20 @@ from predictionio_tpu.data.storage.base import (  # re-export
     EvaluationInstance,
     EvaluationInstances,
     Events,
+    KV,
     Model,
     Models,
+    QueueRecord,
+    SpillQueues,
     StorageError,
     StorageUnavailable,
 )
 from predictionio_tpu.resilience.faults import (
     wrap_events as _wrap_events,
     wrap_instances as _wrap_instances,
+    wrap_kv as _wrap_kv,
     wrap_models as _wrap_models,
+    wrap_spill_queues as _wrap_spill_queues,
 )
 
 __all__ = [
@@ -44,8 +49,8 @@ __all__ = [
     "register_backend",
     "App", "Apps", "AccessKey", "AccessKeys", "Channel", "Channels",
     "EngineInstance", "EngineInstances", "EvaluationInstance",
-    "EvaluationInstances", "Model", "Models", "Events", "StorageError",
-    "StorageUnavailable",
+    "EvaluationInstances", "Model", "Models", "Events", "SpillQueues",
+    "QueueRecord", "KV", "StorageError", "StorageUnavailable",
 ]
 
 
@@ -77,6 +82,14 @@ class _Backend:
     def models(self) -> Models:
         raise StorageError(f"Source type {self.source.type} has no models support.")
 
+    def spill_queues(self) -> SpillQueues:
+        raise StorageError(
+            f"Source type {self.source.type} has no shared-queue support.")
+
+    def kv(self) -> KV:
+        raise StorageError(
+            f"Source type {self.source.type} has no shared-KV support.")
+
     def close(self) -> None:
         pass
 
@@ -98,6 +111,8 @@ class _SQLiteBackend(_Backend):
     def engine_instances(self): return self._client.engine_instances()
     def evaluation_instances(self): return self._client.evaluation_instances()
     def models(self): return self._client.models()
+    def spill_queues(self): return self._client.spill_queues()
+    def kv(self): return self._client.kv()
     def close(self): self._client.close()
 
 
@@ -139,6 +154,8 @@ class _MemoryBackend(_Backend):
         self._engine_instances = m.MemoryEngineInstances()
         self._evaluation_instances = m.MemoryEvaluationInstances()
         self._models = m.MemoryModels()
+        self._spill_queues = m.MemorySpillQueues()
+        self._kv = m.MemoryKV()
 
     def events(self): return self._events
     def apps(self): return self._apps
@@ -147,6 +164,8 @@ class _MemoryBackend(_Backend):
     def engine_instances(self): return self._engine_instances
     def evaluation_instances(self): return self._evaluation_instances
     def models(self): return self._models
+    def spill_queues(self): return self._spill_queues
+    def kv(self): return self._kv
 
 
 class _PioServerBackend(_Backend):
@@ -177,6 +196,8 @@ class _PioServerBackend(_Backend):
     def engine_instances(self): return self._client.engine_instances()
     def evaluation_instances(self): return self._client.evaluation_instances()
     def models(self): return self._client.models()
+    def spill_queues(self): return self._client.spill_queues()
+    def kv(self): return self._client.kv()
     def close(self): self._client.close()
 
 
@@ -252,6 +273,18 @@ class Storage:
     # MODELDATA
     def get_models(self) -> Models:
         return _wrap_models(self._backend_for("MODELDATA").models())
+
+    # Fleet backplane (ISSUE 15) — rides the EVENTDATA source: the spill
+    # queue holds event payloads and the fold-in cache derives from
+    # events, and EVENTDATA is the repository a fleet already points at
+    # shared storage.  Raises StorageError on sources without support
+    # (parquetlog) — callers degrade to the local journal / LRU-only.
+    def get_spill_queues(self) -> SpillQueues:
+        return _wrap_spill_queues(
+            self._backend_for("EVENTDATA").spill_queues())
+
+    def get_kv(self) -> KV:
+        return _wrap_kv(self._backend_for("EVENTDATA").kv())
 
     def close(self) -> None:
         with self._lock:

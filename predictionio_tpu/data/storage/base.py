@@ -33,6 +33,7 @@ __all__ = [
     "EngineInstance",
     "EvaluationInstance",
     "Model",
+    "QueueRecord",
     "Apps",
     "AccessKeys",
     "Channels",
@@ -40,6 +41,8 @@ __all__ = [
     "EvaluationInstances",
     "Models",
     "Events",
+    "SpillQueues",
+    "KV",
     "EVENT_ARROW_SCHEMA",
     "StorageError",
     "StorageUnavailable",
@@ -144,6 +147,29 @@ class Model:
 
     id: str
     models: bytes
+
+
+@dataclass
+class QueueRecord:
+    """One record of a shared spill queue (ISSUE 15).
+
+    ``payload`` is the journal-record JSON object (token/appId/channelId/
+    events); ``state`` walks pending → leased → (acked = deleted | dead).
+    ``lease_expires_s`` is epoch seconds — lease math is done against a
+    CALLER-supplied ``now_s`` so tests (and clock-skewed fleets) reason
+    about expiry explicitly instead of trusting each backend's wall
+    clock."""
+
+    id: str
+    payload: Dict[str, Any]
+    token: Optional[str] = None
+    events: int = 1
+    attempts: int = 0
+    state: str = "pending"            # pending | leased | dead
+    lease_owner: Optional[str] = None
+    lease_expires_s: Optional[float] = None
+    reason: Optional[str] = None      # dead-letter reason
+    enqueued_s: float = 0.0
 
 
 # --------------------------------------------------------------------------
@@ -268,6 +294,97 @@ class Models(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, model_id: str) -> bool: ...
+
+
+class SpillQueues(abc.ABC):
+    """Shared durable work queue with lease/ack semantics (ISSUE 15).
+
+    The fleet-scale replacement for the per-instance JSONL spill journal:
+    N event servers enqueue failed writes into ONE storage-backed queue,
+    and any instance's drainer may lease a batch, replay it, and ack.  A
+    crashed drainer's lease expires (``lease_expires_s`` vs the caller's
+    ``now_s``) and another instance re-leases the batch — replay stays
+    idempotent because each record carries the ORIGINAL write's
+    idempotency token, so the at-least-once redelivery dedups into
+    exactly-once against dedup-capable backends (pioserver).
+
+    Contract pinned by tests/test_fleet.py across sqlite/memory/remote:
+
+    - :meth:`enqueue` is token-idempotent — re-enqueueing a token already
+      queued (lost-reply retry) returns the existing record's id.
+    - :meth:`lease` atomically claims up to ``n`` records that are
+      pending OR whose lease expired before ``now_s``, oldest first,
+      bumping ``attempts`` — two concurrent drainers never hold the same
+      record under an unexpired lease.
+    - :meth:`ack` deletes ONLY records still leased by ``owner`` — an
+      acker whose lease was stolen learns it from the return count.
+    - :meth:`nack` releases records back to pending (transient replay
+      failure: storage still down, retry next tick).
+    - :meth:`dead_letter` parks a permanently unreplayable record (state
+      ``dead``) where :meth:`requeue_dead` can resurrect it after the
+      operator fixes the cause.
+    """
+
+    @abc.abstractmethod
+    def enqueue(self, queue: str, payload: Dict[str, Any],
+                token: Optional[str] = None, events: int = 1,
+                now_s: Optional[float] = None) -> str: ...
+
+    @abc.abstractmethod
+    def lease(self, queue: str, owner: str, n: int, ttl_s: float,
+              now_s: Optional[float] = None) -> List["QueueRecord"]: ...
+
+    @abc.abstractmethod
+    def ack(self, queue: str, ids: Sequence[str], owner: str) -> int: ...
+
+    @abc.abstractmethod
+    def nack(self, queue: str, ids: Sequence[str], owner: str) -> int: ...
+
+    @abc.abstractmethod
+    def dead_letter(self, queue: str, record_id: str, owner: str,
+                    reason: str) -> bool: ...
+
+    @abc.abstractmethod
+    def requeue_dead(self, queue: str) -> int:
+        """Move every dead record back to pending; returns EVENTS
+        requeued (the operator-facing unit, matching the journal)."""
+
+    @abc.abstractmethod
+    def stats(self, queue: str, now_s: Optional[float] = None
+              ) -> Dict[str, Any]:
+        """``{"pending","leased","expired","dead"}`` record counts plus
+        ``*Events`` sums — ``expired`` counts leased records whose lease
+        already lapsed at ``now_s`` (re-leasable work)."""
+
+    @abc.abstractmethod
+    def peek(self, queue: str, n: int = 5, state: str = "pending"
+             ) -> List["QueueRecord"]:
+        """Read-only oldest-first view for ``pio spill inspect`` — takes
+        no lease, never mutates."""
+
+
+class KV(abc.ABC):
+    """Namespaced shared key-value store (ISSUE 15: the durable fold-in
+    cache).  Values are opaque bytes; ``prune`` bounds a namespace by
+    dropping the least-recently-written entries, so N instances can share
+    a cache without any one of them owning an eviction thread."""
+
+    @abc.abstractmethod
+    def put(self, ns: str, key: str, value: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, ns: str, key: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def delete(self, ns: str, key: str) -> bool: ...
+
+    @abc.abstractmethod
+    def count(self, ns: str) -> int: ...
+
+    @abc.abstractmethod
+    def prune(self, ns: str, keep: int) -> int:
+        """Drop all but the ``keep`` most-recently-written entries of
+        ``ns``; returns the number deleted."""
 
 
 # --------------------------------------------------------------------------

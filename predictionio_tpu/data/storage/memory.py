@@ -7,10 +7,12 @@ tests and engine-workflow tests need no filesystem.
 
 from __future__ import annotations
 
+import collections
 import copy
 import datetime as _dt
 import itertools
 import threading
+import time
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -33,6 +35,8 @@ __all__ = [
     "MemoryEvaluationInstances",
     "MemoryModels",
     "MemoryEvents",
+    "MemorySpillQueues",
+    "MemoryKV",
 ]
 
 
@@ -371,3 +375,154 @@ class MemoryEvents(base.Events):
             return None
         return max((e.event_time for e in bucket.values()),
                    key=base.epoch_us)
+
+
+class MemorySpillQueues(base.SpillQueues):
+    """In-process shared spill queue (ISSUE 15).
+
+    "Shared" here means shared by every server in THIS process that holds
+    the same Storage object — exactly what the multi-instance tier-1
+    tests stand up; cross-process deployments ride sqlite or pioserver."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: Dict[str, "collections.OrderedDict[str, base.QueueRecord]"] = {}
+        self._by_token: Dict[Tuple[str, str], str] = {}
+
+    def _q(self, queue: str):
+        return self._queues.setdefault(queue, collections.OrderedDict())
+
+    def enqueue(self, queue, payload, token=None, events=1, now_s=None):
+        now = time.time() if now_s is None else float(now_s)
+        with self._lock:
+            if token is not None:
+                rid = self._by_token.get((queue, token))
+                if rid is not None:
+                    return rid  # lost-reply retry: already queued
+            rid = uuid.uuid4().hex
+            self._q(queue)[rid] = base.QueueRecord(
+                id=rid, payload=copy.deepcopy(payload), token=token,
+                events=int(events), enqueued_s=now)
+            if token is not None:
+                self._by_token[(queue, token)] = rid
+            return rid
+
+    def lease(self, queue, owner, n, ttl_s, now_s=None):
+        now = time.time() if now_s is None else float(now_s)
+        out: List[base.QueueRecord] = []
+        with self._lock:
+            for rec in self._q(queue).values():
+                if len(out) >= int(n):
+                    break
+                claimable = rec.state == "pending" or (
+                    rec.state == "leased"
+                    and rec.lease_expires_s is not None
+                    and rec.lease_expires_s < now)
+                if not claimable:
+                    continue
+                rec.state = "leased"
+                rec.lease_owner = owner
+                rec.lease_expires_s = now + float(ttl_s)
+                rec.attempts += 1
+                out.append(copy.deepcopy(rec))
+        return out
+
+    def _owned(self, queue, ids, owner):
+        q = self._q(queue)
+        return [rid for rid in ids
+                if rid in q and q[rid].state == "leased"
+                and q[rid].lease_owner == owner]
+
+    def ack(self, queue, ids, owner):
+        with self._lock:
+            q = self._q(queue)
+            owned = self._owned(queue, ids, owner)
+            for rid in owned:
+                rec = q.pop(rid)
+                if rec.token is not None:
+                    self._by_token.pop((queue, rec.token), None)
+            return len(owned)
+
+    def nack(self, queue, ids, owner):
+        with self._lock:
+            q = self._q(queue)
+            owned = self._owned(queue, ids, owner)
+            for rid in owned:
+                q[rid].state = "pending"
+                q[rid].lease_owner = None
+                q[rid].lease_expires_s = None
+            return len(owned)
+
+    def dead_letter(self, queue, record_id, owner, reason):
+        with self._lock:
+            owned = self._owned(queue, [record_id], owner)
+            if not owned:
+                return False
+            rec = self._q(queue)[record_id]
+            rec.state = "dead"
+            rec.lease_owner = None
+            rec.lease_expires_s = None
+            rec.reason = str(reason)[:500]
+            return True
+
+    def requeue_dead(self, queue):
+        with self._lock:
+            n_events = 0
+            for rec in self._q(queue).values():
+                if rec.state == "dead":
+                    rec.state = "pending"
+                    rec.reason = None
+                    n_events += rec.events
+            return n_events
+
+    def stats(self, queue, now_s=None):
+        now = time.time() if now_s is None else float(now_s)
+        out = {"pending": 0, "leased": 0, "expired": 0, "dead": 0,
+               "pendingEvents": 0, "leasedEvents": 0, "deadEvents": 0}
+        with self._lock:
+            for rec in self._q(queue).values():
+                out[rec.state] = out.get(rec.state, 0) + 1
+                key = f"{rec.state}Events"
+                out[key] = out.get(key, 0) + rec.events
+                if rec.state == "leased" and rec.lease_expires_s is not None \
+                        and rec.lease_expires_s < now:
+                    out["expired"] += 1
+        return out
+
+    def peek(self, queue, n=5, state="pending"):
+        with self._lock:
+            return [copy.deepcopy(rec) for rec in self._q(queue).values()
+                    if rec.state == state][: int(n)]
+
+
+class MemoryKV(base.KV):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, Tuple[bytes, float]]] = {}
+
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        with self._lock:
+            self._data.setdefault(ns, {})[key] = (bytes(value), time.time())
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        hit = self._data.get(ns, {}).get(key)
+        return hit[0] if hit is not None else None
+
+    def delete(self, ns: str, key: str) -> bool:
+        with self._lock:
+            return self._data.get(ns, {}).pop(key, None) is not None
+
+    def count(self, ns: str) -> int:
+        return len(self._data.get(ns, {}))
+
+    def prune(self, ns: str, keep: int) -> int:
+        with self._lock:
+            entries = self._data.get(ns, {})
+            if len(entries) <= keep:
+                return 0
+            ordered = sorted(entries.items(), key=lambda kv: kv[1][1],
+                             reverse=True)
+            drop = ordered[max(int(keep), 0):]
+            for k, _ in drop:
+                del entries[k]
+            return len(drop)

@@ -64,8 +64,9 @@ from predictionio_tpu.data.event import DataMap, Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import (
     AccessKey, AccessKeys, App, Apps, Channel, Channels, EngineInstance,
-    EngineInstances, EvaluationInstance, EvaluationInstances, Events, Model,
-    Models, StorageError, StorageUnavailable,
+    EngineInstances, EvaluationInstance, EvaluationInstances, Events, KV,
+    Model, Models, QueueRecord, SpillQueues, StorageError,
+    StorageUnavailable,
 )
 from predictionio_tpu.obs import get_registry
 from predictionio_tpu.resilience import current_idempotency_key
@@ -88,6 +89,7 @@ _DATACLASSES = {
     "Event": Event, "App": App, "AccessKey": AccessKey, "Channel": Channel,
     "EngineInstance": EngineInstance,
     "EvaluationInstance": EvaluationInstance, "Model": Model,
+    "QueueRecord": QueueRecord,
 }
 
 
@@ -181,6 +183,12 @@ _ALLOWED = {
     "evaluation_instances": {"insert", "get", "get_all", "get_completed",
                              "update", "delete"},
     "models": {"insert", "get", "delete"},
+    # Shared spill queue + KV (ISSUE 15): the fleet backplane rides the
+    # same RPC surface, so N event servers on type=pioserver share one
+    # queue/cache exactly like they share one event store.
+    "spill_queues": {"enqueue", "lease", "ack", "nack", "dead_letter",
+                     "requeue_dead", "stats", "peek"},
+    "kv": {"put", "get", "delete", "count", "prune"},
 }
 
 
@@ -274,6 +282,13 @@ class StorageServer:
             "evaluation_instances": storage.get_evaluation_instances,
             "models": storage.get_models,
         }
+        # Backplane repos are optional on the hosted storage (a backend
+        # without queue support answers "unknown method", not a crash).
+        for name, getter in (("spill_queues",
+                              getattr(storage, "get_spill_queues", None)),
+                             ("kv", getattr(storage, "get_kv", None))):
+            if getter is not None:
+                self._repos[name] = getter
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -581,7 +596,7 @@ class RemoteClient:
         req = {"m": method, "a": [_enc(a) for a in args],
                "k": {k: _enc(v) for k, v in kwargs.items()}}
         verb = method.split(".", 1)[1] if "." in method else method
-        if not verb.startswith(("get", "find")):
+        if not verb.startswith(("get", "find", "stats", "peek", "count")):
             # Client-generated idempotency token: the server's dedup
             # window makes resending this exact request safe even when
             # the first send committed before the connection died.  The
@@ -663,6 +678,12 @@ class RemoteClient:
 
     def models(self) -> "RemoteModels":
         return RemoteModels(self)
+
+    def spill_queues(self) -> "RemoteSpillQueues":
+        return RemoteSpillQueues(self)
+
+    def kv(self) -> "RemoteKV":
+        return RemoteKV(self)
 
 
 def _forward(repo: str, method: str, iterator: bool = False):
@@ -763,3 +784,37 @@ class RemoteModels(Models):
     insert = _forward("models", "insert")
     get = _forward("models", "get")
     delete = _forward("models", "delete")
+
+
+class RemoteSpillQueues(SpillQueues):
+    """Shared spill queue over the wire — every fleet instance's drainer
+    leases from the SAME server-side table, which is what makes a crashed
+    drainer's batch another instance's work (ISSUE 15)."""
+
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    enqueue = _forward("spill_queues", "enqueue")
+    lease = _forward("spill_queues", "lease")
+    ack = _forward("spill_queues", "ack")
+    nack = _forward("spill_queues", "nack")
+    dead_letter = _forward("spill_queues", "dead_letter")
+    requeue_dead = _forward("spill_queues", "requeue_dead")
+    stats = _forward("spill_queues", "stats")
+    peek = _forward("spill_queues", "peek")
+
+
+class RemoteKV(KV):
+    def __init__(self, client: RemoteClient):
+        self._c = client
+
+    put = _forward("kv", "put")
+    delete = _forward("kv", "delete")
+    count = _forward("kv", "count")
+    prune = _forward("kv", "prune")
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        out = self._c.call("kv.get", ns, key)
+        # bytes ride the __b64__ tagged encoding; None passes through
+        return out if out is None or isinstance(out, bytes) \
+            else bytes(out)

@@ -13,6 +13,7 @@ import datetime as _dt
 import json
 import sqlite3
 import threading
+import time
 import uuid
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional, Sequence
@@ -149,6 +150,39 @@ class SQLiteClient:
                     channelid INTEGER,
                     UNIQUE(appid, channelid))"""
             )
+            # Shared spill queue (ISSUE 15): seq orders the FIFO, token is
+            # the enqueue-idempotency key (a lost-reply re-enqueue must
+            # not duplicate the record), events caches the payload's
+            # event count so stats never parse payloads.
+            c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {ns}_spillqueue (
+                    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                    id TEXT NOT NULL UNIQUE,
+                    queue TEXT NOT NULL,
+                    token TEXT,
+                    payload TEXT NOT NULL,
+                    events INTEGER NOT NULL,
+                    attempts INTEGER NOT NULL DEFAULT 0,
+                    state TEXT NOT NULL DEFAULT 'pending',
+                    leaseowner TEXT,
+                    leaseexpires REAL,
+                    reason TEXT,
+                    enqueued REAL NOT NULL,
+                    UNIQUE(queue, token))"""
+            )
+            c.execute(
+                f"""CREATE INDEX IF NOT EXISTS {ns}_spillqueue_scan
+                    ON {ns}_spillqueue (queue, state, seq)"""
+            )
+            # Shared KV (ISSUE 15: durable fold-in cache).
+            c.execute(
+                f"""CREATE TABLE IF NOT EXISTS {ns}_kv (
+                    ns TEXT NOT NULL,
+                    key TEXT NOT NULL,
+                    value BLOB NOT NULL,
+                    updated REAL NOT NULL,
+                    PRIMARY KEY (ns, key))"""
+            )
 
     def close(self) -> None:
         with self._lock:
@@ -175,6 +209,12 @@ class SQLiteClient:
 
     def events(self) -> "SQLiteEvents":
         return SQLiteEvents(self)
+
+    def spill_queues(self) -> "SQLiteSpillQueues":
+        return SQLiteSpillQueues(self)
+
+    def kv(self) -> "SQLiteKV":
+        return SQLiteKV(self)
 
 
 class _Repo:
@@ -796,3 +836,187 @@ class SQLiteEvents(_Repo, base.Events):
                 self._conn.executemany(sql, rows)
                 n += len(eid)
         return n
+
+
+class SQLiteSpillQueues(_Repo, base.SpillQueues):
+    """Shared spill queue over one sqlite file (ISSUE 15).
+
+    Lease claims are per-row conditional UPDATEs (``WHERE id=? AND
+    (pending OR expired)``), each atomic at the sqlite level, so two
+    drainer processes sharing the file can race a lease and exactly one
+    wins each record — no table lock held across the batch."""
+
+    _COLS = ("id,queue,token,payload,events,attempts,state,leaseowner,"
+             "leaseexpires,reason,enqueued")
+
+    def _from_row(self, r) -> base.QueueRecord:
+        return base.QueueRecord(
+            id=r[0], payload=json.loads(r[3]), token=r[2], events=r[4],
+            attempts=r[5], state=r[6], lease_owner=r[7],
+            lease_expires_s=r[8], reason=r[9], enqueued_s=r[10])
+
+    def enqueue(self, queue, payload, token=None, events=1, now_s=None):
+        rid = uuid.uuid4().hex
+        now = time.time() if now_s is None else float(now_s)
+        with self._lock:
+            if token is not None:
+                row = self._conn.execute(
+                    f"SELECT id FROM {self._ns}_spillqueue "
+                    "WHERE queue=? AND token=?", (queue, token)).fetchone()
+                if row is not None:
+                    return row[0]  # lost-reply retry: already queued
+            try:
+                with self._conn:
+                    self._conn.execute(
+                        f"INSERT INTO {self._ns}_spillqueue "
+                        "(id,queue,token,payload,events,attempts,state,"
+                        "leaseowner,leaseexpires,reason,enqueued) "
+                        "VALUES (?,?,?,?,?,0,'pending',NULL,NULL,NULL,?)",
+                        (rid, queue, token,
+                         json.dumps(payload, separators=(",", ":")),
+                         int(events), now))
+            except sqlite3.IntegrityError:
+                # (queue, token) raced another process's enqueue
+                row = self._conn.execute(
+                    f"SELECT id FROM {self._ns}_spillqueue "
+                    "WHERE queue=? AND token=?", (queue, token)).fetchone()
+                if row is not None:
+                    return row[0]
+                raise
+        return rid
+
+    def lease(self, queue, owner, n, ttl_s, now_s=None):
+        now = time.time() if now_s is None else float(now_s)
+        expires = now + float(ttl_s)
+        claimed: List[str] = []
+        with self._lock, self._conn:
+            rows = self._conn.execute(
+                f"SELECT id FROM {self._ns}_spillqueue WHERE queue=? AND "
+                "(state='pending' OR (state='leased' AND leaseexpires<?)) "
+                "ORDER BY seq LIMIT ?", (queue, now, int(n))).fetchall()
+            for (rid,) in rows:
+                cur = self._conn.execute(
+                    f"UPDATE {self._ns}_spillqueue SET state='leased', "
+                    "leaseowner=?, leaseexpires=?, attempts=attempts+1 "
+                    "WHERE id=? AND (state='pending' OR "
+                    "(state='leased' AND leaseexpires<?))",
+                    (owner, expires, rid, now))
+                if cur.rowcount:
+                    claimed.append(rid)
+            if not claimed:
+                return []
+            out = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_spillqueue "
+                f"WHERE id IN ({','.join('?' * len(claimed))}) "
+                "ORDER BY seq", claimed).fetchall()
+        return [self._from_row(r) for r in out]
+
+    def ack(self, queue, ids, owner):
+        ids = list(ids)
+        if not ids:
+            return 0
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                f"DELETE FROM {self._ns}_spillqueue WHERE queue=? AND "
+                f"leaseowner=? AND state='leased' AND "
+                f"id IN ({','.join('?' * len(ids))})",
+                [queue, owner] + ids)
+            return cur.rowcount
+
+    def nack(self, queue, ids, owner):
+        ids = list(ids)
+        if not ids:
+            return 0
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                f"UPDATE {self._ns}_spillqueue SET state='pending', "
+                f"leaseowner=NULL, leaseexpires=NULL WHERE queue=? AND "
+                f"leaseowner=? AND state='leased' AND "
+                f"id IN ({','.join('?' * len(ids))})",
+                [queue, owner] + ids)
+            return cur.rowcount
+
+    def dead_letter(self, queue, record_id, owner, reason):
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                f"UPDATE {self._ns}_spillqueue SET state='dead', "
+                "leaseowner=NULL, leaseexpires=NULL, reason=? "
+                "WHERE queue=? AND id=? AND leaseowner=? AND "
+                "state='leased'", (str(reason)[:500], queue, record_id,
+                                   owner))
+            return cur.rowcount > 0
+
+    def requeue_dead(self, queue):
+        with self._lock, self._conn:
+            row = self._conn.execute(
+                f"SELECT COALESCE(SUM(events),0) FROM "
+                f"{self._ns}_spillqueue WHERE queue=? AND state='dead'",
+                (queue,)).fetchone()
+            self._conn.execute(
+                f"UPDATE {self._ns}_spillqueue SET state='pending', "
+                "reason=NULL WHERE queue=? AND state='dead'", (queue,))
+            return int(row[0])
+
+    def stats(self, queue, now_s=None):
+        now = time.time() if now_s is None else float(now_s)
+        out = {"pending": 0, "leased": 0, "expired": 0, "dead": 0,
+               "pendingEvents": 0, "leasedEvents": 0, "deadEvents": 0}
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT state, leaseexpires<?, COUNT(*), "
+                f"COALESCE(SUM(events),0) FROM {self._ns}_spillqueue "
+                "WHERE queue=? GROUP BY state, leaseexpires<?",
+                (now, queue, now)).fetchall()
+        for state, expired, n, ev in rows:
+            out[state] = out.get(state, 0) + n
+            out[f"{state}Events"] = out.get(f"{state}Events", 0) + ev
+            if state == "leased" and expired:
+                out["expired"] += n
+        return out
+
+    def peek(self, queue, n=5, state="pending"):
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {self._COLS} FROM {self._ns}_spillqueue "
+                "WHERE queue=? AND state=? ORDER BY seq LIMIT ?",
+                (queue, state, int(n))).fetchall()
+        return [self._from_row(r) for r in rows]
+
+
+class SQLiteKV(_Repo, base.KV):
+    def put(self, ns: str, key: str, value: bytes) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                f"INSERT OR REPLACE INTO {self._ns}_kv "
+                "(ns, key, value, updated) VALUES (?,?,?,?)",
+                (ns, key, sqlite3.Binary(bytes(value)), time.time()))
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT value FROM {self._ns}_kv WHERE ns=? AND key=?",
+                (ns, key)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def delete(self, ns: str, key: str) -> bool:
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                f"DELETE FROM {self._ns}_kv WHERE ns=? AND key=?",
+                (ns, key))
+            return cur.rowcount > 0
+
+    def count(self, ns: str) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT COUNT(*) FROM {self._ns}_kv WHERE ns=?",
+                (ns,)).fetchone()
+        return int(row[0])
+
+    def prune(self, ns: str, keep: int) -> int:
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                f"DELETE FROM {self._ns}_kv WHERE ns=? AND key NOT IN "
+                f"(SELECT key FROM {self._ns}_kv WHERE ns=? "
+                "ORDER BY updated DESC LIMIT ?)",
+                (ns, ns, max(int(keep), 0)))
+            return cur.rowcount

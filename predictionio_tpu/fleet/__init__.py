@@ -1,0 +1,41 @@
+"""Fleet-scale serving plane: coordinated generation rollout (ISSUE 15).
+
+PR 9 gave the fleet *eyes* (merged telemetry, per-instance SLO burn) and
+PR 10/11 gave each instance a private promotion loop (staged-reload
+canary + SLO/quality auto-rollback).  This package is the fleet's
+*hands*: one controller that promotes a new generation across N replicas
+in waves (1 → 25% → 100%, configurable), gates every wave on the
+fleet-merged SLO burn AND the merged ``/quality.json`` verdict, and —
+when a wave degrades — halts and rolls back EVERY already-promoted
+instance through the existing ``/admin/rollback`` path, so a bad
+generation can never stay half-promoted across a load-balanced fleet.
+
+Structural rule (``tools/lint_refresh.py`` rule 4): multi-instance
+promotion goes through :class:`~predictionio_tpu.fleet.rollout.
+RolloutController` — a loop POSTing ``/reload`` over an instance list
+anywhere outside this package is a lint violation, because a bare loop
+has no wave gate, no journaled state to resume from, and no whole-fleet
+unwind.
+
+Entry points: ``pio rollout`` (one coordinated rollout, resumable), and
+the PR-10 refresh daemon — ``pio train --follow`` with a comma-separated
+``--promote-url`` list promotes every cycle through a
+:class:`~predictionio_tpu.fleet.rollout.FleetPromoter` instead of a
+single-instance ``HttpPromoter``.
+"""
+
+from predictionio_tpu.fleet.rollout import (  # noqa: F401
+    FleetPromoter,
+    RolloutConfig,
+    RolloutController,
+    parse_waves,
+    rollout_state_path,
+)
+
+__all__ = [
+    "RolloutController",
+    "RolloutConfig",
+    "FleetPromoter",
+    "parse_waves",
+    "rollout_state_path",
+]

@@ -406,3 +406,13 @@ class FleetAggregator:
         """scrape_once + payload — the dashboard's GET /fleet.json."""
         self.scrape_once()
         return self.payload()
+
+    def close(self) -> None:
+        """Release the persistent scrape pool.  Long-lived owners (the
+        dashboard) never need this; short-lived ones (a rollout
+        controller, `pio status --fleet`) should not leak a thread pool
+        per invocation."""
+        with self._lock:
+            pool, self._scrape_pool = self._scrape_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)

@@ -222,10 +222,21 @@ class RefreshDaemon:
         self.metrics = RefreshMetrics(registry)
         self.stop_event = threading.Event()
         if promoter is None and self.config.promote_url:
-            promoter = HttpPromoter(
-                self.config.promote_url,
-                canary_window_s=self.config.canary_window_s,
-                canary_poll_s=self.config.canary_poll_s)
+            urls = [u.strip() for u in self.config.promote_url.split(",")
+                    if u.strip()]
+            if len(urls) > 1:
+                # Fleet mode (ISSUE 15): N instance URLs promote through
+                # the wave-based rollout controller — gated waves, fleet
+                # SLO/quality gate, whole-fleet rollback — never a bare
+                # promote loop (tools/lint_refresh.py rule 4).
+                from predictionio_tpu.fleet import FleetPromoter
+
+                promoter = FleetPromoter(urls)
+            else:
+                promoter = HttpPromoter(
+                    urls[0],
+                    canary_window_s=self.config.canary_window_s,
+                    canary_poll_s=self.config.canary_poll_s)
         self.promoter = promoter
         # appName out of the variant: the staleness gauge compares the
         # app's ingest high-watermark against the served window.

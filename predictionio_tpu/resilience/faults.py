@@ -55,6 +55,8 @@ __all__ = [
     "wrap_events",
     "wrap_instances",
     "wrap_models",
+    "wrap_spill_queues",
+    "wrap_kv",
 ]
 
 
@@ -286,3 +288,43 @@ def wrap_models(models: Any) -> Any:
     if _current_plan() is None:
         return models
     return _FaultyRepo(models, _MODELS_POINTS)
+
+
+# Shared spill backplane (ISSUE 15): every queue op is individually
+# breakable so chaos tests can stage a lease steal ("spillq.lease:error"
+# on one instance), an expired-lease race, or a storage error mid-ack
+# ("spillq.ack:error:1.0:1" — the records stay leased, expire, and
+# another drainer replays them; idempotency tokens keep that
+# exactly-once).
+_SPILLQ_POINTS = {
+    "enqueue": "spillq.enqueue",
+    "lease": "spillq.lease",
+    "ack": "spillq.ack",
+    "nack": "spillq.nack",
+    "dead_letter": "spillq.dead_letter",
+    "requeue_dead": "spillq.requeue_dead",
+    "stats": "spillq.stats",
+    "peek": "spillq.stats",
+}
+
+_KV_POINTS = {
+    "get": "kv.get",
+    "count": "kv.get",
+    "put": "kv.put",
+    "prune": "kv.put",
+    "delete": "kv.delete",
+}
+
+
+def wrap_spill_queues(queues: Any) -> Any:
+    """Fault seam over a SpillQueues repository (the shared backplane)."""
+    if _current_plan() is None:
+        return queues
+    return _FaultyRepo(queues, _SPILLQ_POINTS)
+
+
+def wrap_kv(kv: Any) -> Any:
+    """Fault seam over a KV repository (the durable fold-in cache)."""
+    if _current_plan() is None:
+        return kv
+    return _FaultyRepo(kv, _KV_POINTS)

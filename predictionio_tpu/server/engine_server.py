@@ -289,15 +289,21 @@ class EngineServer:
         # behind the PIO_QUALITY kill switch (off = inert no-op hooks).
         self.quality = QualityMonitor(registry=reg)
 
-    def _load_candidate(self):
+    def _load_candidate(self, target_instance_id: Optional[str] = None):
         """Storage-read phase of the staged reload (runs under the
-        breaker): resolve the target instance and load its models."""
+        breaker): resolve the target instance and load its models.
+
+        ``target_instance_id`` (ISSUE 15) pins the candidate explicitly
+        — the fleet rollout controller names ONE instance id on every
+        ``POST /reload`` so a newer COMPLETED train landing mid-wave can
+        never split the fleet across generations."""
         instances = self.storage.get_engine_instances()
-        if self.requested_instance_id:
-            instance = instances.get(self.requested_instance_id)
+        requested = target_instance_id or self.requested_instance_id
+        if requested:
+            instance = instances.get(requested)
             if instance is None or instance.status != "COMPLETED":
                 raise WorkflowError(
-                    f"Engine instance {self.requested_instance_id!r} not found "
+                    f"Engine instance {requested!r} not found "
                     "or not COMPLETED.")
         else:
             instance = instances.get_latest_completed(
@@ -354,16 +360,18 @@ class EngineServer:
         publish_event("model.reload", result=result,
                       **({"error": error[:200]} if error else {}), **extra)
 
-    def reload(self) -> str:
-        """Staged reload of the latest COMPLETED instance (reference:
-        /reload after retrain — MasterActor swaps ServerActor).
+    def reload(self, target_instance_id: Optional[str] = None) -> str:
+        """Staged reload of the latest COMPLETED instance — or, with
+        ``target_instance_id``, of exactly THAT instance (the rollout
+        controller's generation-atomic wave contract).
 
         read (breaker-guarded) → build → validate → swap; any failure
         keeps the last-good generation serving and raises.  The previous
         generation is retained for :meth:`rollback`."""
         with self._reload_lock:
             try:
-                instance, models = self._breaker.call(self._load_candidate)
+                instance, models = self._breaker.call(
+                    self._load_candidate, target_instance_id)
                 engine_params = instance_engine_params(self.engine, instance)
                 algorithms = self.engine.make_algorithms(engine_params)
                 serving = self.engine.make_serving(engine_params)
@@ -674,14 +682,34 @@ class EngineServer:
                 # chrome://tracing / Perfetto export.
                 return 200, timeline_payload(params)
             if path == "/reload" and method == "POST":
+                # Optional target pin (ISSUE 15): the rollout controller
+                # posts {"engineInstanceId": ...} so every instance in a
+                # wave loads the SAME candidate.
+                target = None
+                if body:
+                    try:
+                        target = (json.loads(body.decode("utf-8"))
+                                  or {}).get("engineInstanceId")
+                    except (ValueError, AttributeError):
+                        return 400, {"message": "reload body must be "
+                                                "JSON"}
                 try:
-                    instance_id = self.reload()
+                    instance_id = self.reload(target)
                 except ModelValidationError as e:
                     # Candidate rejected by the validation stage: the
                     # last-good model keeps serving — a client fault
                     # (bad train), not an availability failure.
                     return 409, {"message": str(e),
                                  "status": "rejected"}
+                except WorkflowError as e:
+                    if target:
+                        # An explicitly named candidate this server
+                        # cannot load (not COMPLETED / unknown): reject
+                        # like a validation failure — the wave skips and
+                        # reports, last-good keeps serving.
+                        return 409, {"message": str(e),
+                                     "status": "rejected"}
+                    raise
                 return 200, {"status": "reloaded",
                              "engineInstanceId": instance_id,
                              "generation": self._generation}

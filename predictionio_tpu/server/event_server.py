@@ -53,6 +53,11 @@ from predictionio_tpu.resilience import idempotency_key
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.resilience.faults import fault_point
 from predictionio_tpu.resilience.policy import CircuitBreaker, CircuitOpenError
+from predictionio_tpu.resilience.shared_spill import (
+    LeaseDrainer,
+    SharedSpillQueue,
+    resolve_spill_backend,
+)
 from predictionio_tpu.resilience.spill import (
     ReplayWorker,
     SpillJournal,
@@ -132,8 +137,10 @@ class EventServer:
                  port: int = 7070, plugins=None, *,
                  breaker: Optional[CircuitBreaker] = None,
                  spill_dir: Optional[str] = None,
+                 spill_backend: Optional[str] = None,
                  replay_interval_s: Optional[float] = None,
-                 replay_wait=None):
+                 replay_wait=None,
+                 drain_wait=None):
         from predictionio_tpu.server.plugins import PluginManager
 
         self.storage = storage or get_storage()
@@ -178,15 +185,48 @@ class EventServer:
         self._latest_lock = threading.Lock()
         self.spill: Optional[SpillJournal] = None
         self._replay: Optional[ReplayWorker] = None
+        self.shared_spill: Optional[SharedSpillQueue] = None
+        self._lease_drainer: Optional[LeaseDrainer] = None
+        replay_interval = (replay_interval_s if replay_interval_s is not None
+                           else float(os.environ.get(
+                               "PIO_SPILL_REPLAY_INTERVAL_S", "0.5")))
+        # Shared spill backplane (ISSUE 15): failed writes enqueue into
+        # the storage-backed fleet queue; this instance also runs a lease
+        # drainer so ANY instance (including a freshly restarted one) can
+        # replay a crashed peer's batch.  The local journal below stays
+        # as the last-resort spill-of-the-spill — when storage itself is
+        # the outage, the shared enqueue fails too.
+        try:
+            ev_type = self.storage.config.source_for("EVENTDATA").type
+        except Exception:
+            ev_type = None
+        self.spill_backend = resolve_spill_backend(spill_backend, ev_type)
+        if self.spill_backend == "shared":
+            try:
+                self.storage.get_spill_queues()  # probe support
+                self.shared_spill = SharedSpillQueue(self.storage)
+            except Exception as e:
+                logger.warning("shared spill backend unavailable (%s) — "
+                               "falling back to the local journal", e)
+                self.spill_backend = "local"
+            else:
+                # Owner must be globally unique — ack/dead_letter use it
+                # to detect lease steals, and host:port collides when
+                # port=0 is not yet resolved or two servers share a pid.
+                self._lease_drainer = LeaseDrainer(
+                    self.shared_spill, self._replay_insert,
+                    owner=f"{host}:{os.getpid()}-{uuid.uuid4().hex[:6]}",
+                    interval_s=replay_interval,
+                    transient_types=_UNAVAILABLE + (OSError,),
+                    wait=drain_wait)
+                self._lease_drainer.start()
         spill_path = resolve_spill_dir(
             spill_dir, getattr(self.storage.config, "home", None))
         if spill_path is not None:
             self.spill = SpillJournal(spill_path)
             self._replay = ReplayWorker(
                 self.spill, self._replay_insert,
-                interval_s=(replay_interval_s if replay_interval_s is not None
-                            else float(os.environ.get(
-                                "PIO_SPILL_REPLAY_INTERVAL_S", "0.5"))),
+                interval_s=replay_interval,
                 transient_types=_UNAVAILABLE + (OSError,),
                 # Injectable tick wait (tests drive replay with a fake
                 # clock / direct drain instead of wall-clock polling).
@@ -205,11 +245,30 @@ class EventServer:
     def _spill_events(self, events_json: List[Any], app_id: int,
                       channel_id: Optional[int],
                       token: str) -> Optional[str]:
-        """Durably journal one failed write (single event or whole batch)
+        """Durably queue one failed write (single event or whole batch)
         under the SAME idempotency token the write was issued with — if
         the "outage" was really a lost reply, the backend committed and
-        replay must dedup against it, not re-insert.  Returns the token,
-        or None when spilling is disabled/broken (caller 503s)."""
+        replay must dedup against it, not re-insert.
+
+        Shared backend first (the fleet queue: any instance's drainer
+        replays it, a crash here strands nothing); the local journal is
+        the fallback for when storage itself is the outage — the shared
+        enqueue rides the same storage that just failed the write, so it
+        usually fails too and the record degrades to the local file.
+        Returns the token, or None when no home accepted it (caller
+        503s)."""
+        # Breaker-open = storage is KNOWN down, and the shared queue
+        # rides that same storage: skip the doomed enqueue (which would
+        # stack one RPC timeout onto every degraded request) and go
+        # straight to the local journal; the drainer replays it into the
+        # shared path's store once the breaker recloses.
+        if self.shared_spill is not None and self._breaker.state != "open":
+            try:
+                return self.shared_spill.append(events_json, app_id,
+                                                channel_id, token=token)
+            except Exception:
+                logger.warning("shared spill enqueue failed — degrading "
+                               "to the local journal", exc_info=True)
         if self.spill is None:
             return None
         try:
@@ -391,8 +450,14 @@ class EventServer:
             st = self._breaker.state
             body_ = {"status": "ready" if st == "closed" else "unavailable",
                      "breaker": st,
+                     "spillBackend": self.spill_backend,
                      "spillQueueDepth": self.spill.depth() if self.spill
                      else 0}
+            if self.shared_spill is not None:
+                # cached: a readiness probe must never block on a
+                # storage RPC while storage is the thing that is down
+                body_["sharedSpillDepth"] = \
+                    self.shared_spill.cached_depth()
             return (200 if st == "closed" else 503), body_
         if path == "/stats.json" and method == "GET":
             return 200, self.stats.snapshot()
@@ -704,6 +769,8 @@ class EventServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._lease_drainer is not None:
+            self._lease_drainer.stop()
         if self._replay is not None:
             self._replay.stop()
         elif self.spill is not None:
@@ -714,6 +781,9 @@ class EventServer:
         """Graceful SIGTERM/SIGINT path: stop accepting, finish in-flight
         requests, flush the spill journal to disk (it replays on next
         boot or when storage recovers)."""
-        logger.info("Event server draining (spill depth=%d)",
-                    self.spill.depth() if self.spill else 0)
+        shared = (self.shared_spill.cached_depth()
+                  if self.shared_spill is not None else None)
+        logger.info("Event server draining (local spill depth=%d, shared "
+                    "queue depth=%s)",
+                    self.spill.depth() if self.spill else 0, shared)
         self.stop()

@@ -275,6 +275,75 @@ def _fold_metric():
 _FOLD_NEG_TTL_S = 30.0
 
 
+# -- durable shared fold-in cache (ISSUE 15) --------------------------------
+#
+# N fleet instances each solving the SAME visitor is wasted work and a
+# restarted instance re-solves everyone from zero.  Solved factors are
+# therefore persisted (best-effort) in the storage layer's shared KV,
+# keyed by (factor fingerprint, user): the fingerprint — a SHA-1 of the
+# generation's item-factor bytes — identifies the EXACT matrix the solve
+# is valid against, so two instances serving the same promoted pickle
+# share entries while a rollback/reload to different factors naturally
+# misses.  The local per-generation LRU stays the read-through layer;
+# the KV is only consulted on an LRU miss.  Entries carry the event-time
+# watermark of the newest event they were solved from — a shared hit may
+# be staler than a fresh solve would be (documented README caveat; the
+# next refresh trains the user in either way).  Negative outcomes are
+# never shared: "no events yet" goes stale in seconds.
+
+def _fold_shared_enabled() -> bool:
+    from predictionio_tpu.config import env_bool
+
+    return env_bool(os.environ.get("PIO_FOLD_IN_SHARED"), True)
+
+
+def _fold_encode(vec: np.ndarray, watermark_us: Optional[int]) -> bytes:
+    """Header carries the SOLVE time (``ts``, epoch s — the max-age
+    gate's anchor: age of the entry, so a re-solve refreshes it) and the
+    event-time watermark of the newest event consumed (``wm`` — the
+    operator-facing freshness record)."""
+    import json as _json
+    import time as _time
+
+    v = np.ascontiguousarray(vec, dtype=np.float32)
+    head = _json.dumps({"n": int(v.shape[0]), "wm": watermark_us,
+                        "ts": round(_time.time(), 3)},
+                       separators=(",", ":")).encode()
+    return head + b"\n" + v.tobytes()
+
+
+def _fold_decode(blob: bytes
+                 ) -> Optional[Tuple[np.ndarray, Optional[float]]]:
+    """(vector, solve-time epoch-s) — the solve time anchors the
+    max-age gate."""
+    import json as _json
+
+    try:
+        head, raw = blob.split(b"\n", 1)
+        meta = _json.loads(head)
+        vec = np.frombuffer(raw, dtype=np.float32)
+        if vec.shape[0] != int(meta["n"]):
+            return None
+        ts = meta.get("ts")
+        return vec.copy(), (float(ts) if ts is not None else None)
+    except Exception:
+        return None
+
+
+def _fold_shared_max_age_s() -> float:
+    """``PIO_FOLD_IN_SHARED_MAX_AGE_S`` (0 = accept any age): a shared
+    entry SOLVED longer ago than this is treated as a MISS so the
+    reader re-solves (picking up any events that arrived since).  Anchor
+    is the solve time, NOT the user's newest event time — gating on
+    event recency would permanently expire every idle user's entry and
+    churn re-solves exactly where sharing is safest."""
+    try:
+        return float(os.environ.get("PIO_FOLD_IN_SHARED_MAX_AGE_S",
+                                    "0") or 0)
+    except ValueError:
+        return 0.0
+
+
 # eq=False: wrapper identity IS the model generation — keeps the object
 # hashable for the weak-keyed retriever cache.
 @dataclasses.dataclass(eq=False)
@@ -335,6 +404,12 @@ class ALSModelWrapper:
         self._fold_lock = threading.Lock()
         self._event_store = None
         self._yty: Optional[np.ndarray] = None
+        # Durable shared cache (ISSUE 15): the KV handle arrives at
+        # post_load (the one hook that sees the serving ctx), the
+        # fingerprint binds entries to THIS generation's factors.
+        self._shared_kv = None
+        self._fold_fp: Optional[str] = None
+        self._fold_puts = 0
 
     def __getstate__(self):
         # serving caches are transient (a reloaded model rebuilds them;
@@ -343,7 +418,8 @@ class ALSModelWrapper:
         d = self.__dict__.copy()
         d["_host"] = None
         d["_host_uf"] = None
-        for k in ("_fold_cache", "_fold_lock", "_event_store", "_yty"):
+        for k in ("_fold_cache", "_fold_lock", "_event_store", "_yty",
+                  "_shared_kv", "_fold_fp", "_fold_puts"):
             d.pop(k, None)
         return d
 
@@ -420,6 +496,15 @@ class ALSModelWrapper:
                     _fold_metric().inc(result="cached")
                     return vec
                 del self._fold_cache[user]  # expired negative: re-check
+        # Shared read-through (ISSUE 15): another instance may already
+        # have solved this visitor against the SAME factors — one KV get
+        # beats an event-store read plus a ridge solve, and a restarted
+        # instance warms from the fleet's work.
+        shared_vec = self._fold_shared_get(user)
+        if shared_vec is not None:
+            self._fold_store(user, shared_vec)
+            _fold_metric().inc(result="shared")
+            return shared_vec
         from predictionio_tpu.obs import span
 
         try:
@@ -438,6 +523,7 @@ class ALSModelWrapper:
             return None
         ids: List[int] = []
         vals: List[float] = []
+        watermark_us: Optional[int] = None
         for ev in events:
             idx = self.item_index.get(ev.target_entity_id)
             if idx is None:
@@ -450,6 +536,12 @@ class ALSModelWrapper:
             else:
                 vals.append(float(self.buy_rating))
             ids.append(int(idx))
+            from predictionio_tpu.data.storage.base import epoch_us
+
+            us = epoch_us(ev.event_time)
+            if us is not None and (watermark_us is None
+                                   or us > watermark_us):
+                watermark_us = us
         if not ids:
             self._fold_store(user, None)
             _fold_metric().inc(result="no_events")
@@ -464,8 +556,70 @@ class ALSModelWrapper:
             alpha=float(getattr(self, "alpha", 1.0)),
             implicit=self.model.implicit, yty=self._yty)
         self._fold_store(user, vec)
+        self._fold_shared_put(user, vec, watermark_us)
         _fold_metric().inc(result="solved")
         return vec
+
+    # -- durable shared cache plumbing (ISSUE 15) ----------------------
+
+    def _fold_ns(self) -> str:
+        """KV namespace binding entries to THIS generation's factors:
+        two instances serving the same promoted pickle hash identical
+        bytes and share; different factors (rollback, refresh) miss."""
+        if self._fold_fp is None:
+            import hashlib
+
+            _, itf = self.host_factors()
+            self._fold_fp = hashlib.sha1(
+                np.ascontiguousarray(itf, dtype=np.float32).tobytes()
+            ).hexdigest()[:16]
+        return f"foldin:{self._fold_fp}"
+
+    def _fold_shared_get(self, user: str) -> Optional[np.ndarray]:
+        kv = getattr(self, "_shared_kv", None)
+        if kv is None or not _fold_shared_enabled():
+            return None
+        try:
+            blob = kv.get(self._fold_ns(), user)
+        except Exception:
+            # A KV blip must never fail the request — the local solve
+            # path below still answers.
+            logging.getLogger(__name__).debug(
+                "shared fold-in get failed", exc_info=True)
+            return None
+        if not blob:
+            return None
+        decoded = _fold_decode(blob)
+        if decoded is None:
+            return None
+        vec, solved_at = decoded
+        if vec.shape[0] != self.model.item_factors.shape[-1]:
+            return None
+        max_age = _fold_shared_max_age_s()
+        if max_age > 0 and solved_at is not None:
+            import time as _time
+
+            if _time.time() - solved_at > max_age:
+                return None  # stale solve: miss → re-solve fresh
+        return vec
+
+    def _fold_shared_put(self, user: str, vec: np.ndarray,
+                         watermark_us: Optional[int]) -> None:
+        """Best-effort write-through; every 256th put prunes the
+        namespace to ``PIO_FOLD_IN_SHARED_CAP`` so the shared cache
+        stays bounded without any instance owning an eviction thread."""
+        kv = getattr(self, "_shared_kv", None)
+        if kv is None or not _fold_shared_enabled():
+            return
+        try:
+            ns = self._fold_ns()
+            kv.put(ns, user, _fold_encode(vec, watermark_us))
+            self._fold_puts += 1
+            if self._fold_puts % 256 == 0:
+                kv.prune(ns, _env_int("PIO_FOLD_IN_SHARED_CAP", 100_000))
+        except Exception:
+            logging.getLogger(__name__).debug(
+                "shared fold-in put failed", exc_info=True)
 
     def _fold_store(self, user: str, vec: Optional[np.ndarray]) -> None:
         """Bounded-LRU insert; ``vec=None`` is the (TTL'd) negative
@@ -497,6 +651,16 @@ class ALSModelWrapper:
         store = getattr(ctx, "event_store", None)
         if store is not None:
             self._event_store = store
+        # Durable fold-in cache (ISSUE 15): stash the shared KV when the
+        # serving storage supports it — read-through on LRU misses,
+        # write-through after solves.  Unsupported backends (parquetlog)
+        # leave it None and fold-in stays LRU-only, exactly as before.
+        storage = getattr(ctx, "storage", None)
+        if storage is not None and _fold_shared_enabled():
+            try:
+                self._shared_kv = storage.get_kv()
+            except Exception:
+                self._shared_kv = None
         mesh = getattr(ctx, "mesh", None)
         if mesh is None:
             return

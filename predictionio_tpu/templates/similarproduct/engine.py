@@ -18,8 +18,12 @@ Substrate: the pairwise-cosine top-K is one normalized matmul on the MXU
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
+import logging
+import os
+import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -70,6 +74,12 @@ class ViewData:
     user_index: BiMap
     item_index: BiMap
     item_categories: Dict[str, Set[str]]
+    # Item-side fold-in context (ISSUE 15): the trained model needs to
+    # know where its view events live so an UNKNOWN query item (a brand
+    # new product) can be folded in at serve time.  Defaults keep older
+    # pickles/tests loading.
+    app_name: Optional[str] = None
+    event_names: Sequence[str] = ("view",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,6 +117,8 @@ class SimilarProductDataSource(DataSource):
             user_index=user_index,
             item_index=item_index,
             item_categories=cats,
+            app_name=p.appName,
+            event_names=tuple(p.eventNames),
         )
 
 
@@ -119,6 +131,36 @@ class ALSAlgorithmParams(Params):
     seed: Optional[int] = None
 
 
+def _item_fold_in_enabled() -> bool:
+    # Same kill switch as the user-side fold-in (ISSUE 10/15): one knob
+    # turns every serve-time solve off.
+    from predictionio_tpu.config import env_bool
+
+    return env_bool(os.environ.get("PIO_FOLD_IN"), True)
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        return int(os.environ.get(key, str(default)) or default)
+    except ValueError:
+        return default
+
+
+def _item_fold_metric():
+    from predictionio_tpu.obs import get_registry
+
+    return get_registry().counter(
+        "pio_fold_in_items_total",
+        "Serve-time item-side fold-in attempts by outcome "
+        "(cached/solved/no_events/unavailable).", ("result",))
+
+
+# Negative-entry TTL, same rationale as the user-side cache: a brand-new
+# item's first views should fold in within seconds, not be pinned cold
+# for the generation's lifetime.
+_ITEM_FOLD_NEG_TTL_S = 30.0
+
+
 # eq=False: wrapper identity IS the model generation (weak-keyed
 # retriever cache needs a hashable owner).
 @dataclasses.dataclass(eq=False)
@@ -126,12 +168,134 @@ class SimilarProductModel:
     item_factors: np.ndarray       # [I, K] L2-normalized
     item_index: BiMap
     item_categories: Dict[str, Set[str]]
+    # Item-side fold-in (ISSUE 15, the carried PR-10 rung): a query item
+    # UNKNOWN to this generation (a product added after the last
+    # refresh) gets one implicit ridge solve against the frozen USER
+    # factors from the users who recently viewed it — "similar to this
+    # brand-new product" answers instead of staying cold until the next
+    # refresh.  Same bounded per-generation cache + PIO_FOLD_IN kill
+    # switch as the recommendation template's user-side fold-in; None
+    # user_factors (old pickles) disables it.
+    user_factors: Optional[np.ndarray] = None   # [U, K] RAW (unnormalized)
+    user_index: Optional[BiMap] = None
+    app_name: Optional[str] = None
+    fold_event_names: Sequence[str] = ("view",)
+    reg: float = 0.01
+    alpha: float = 1.0
+
+    def __post_init__(self):
+        self._init_transients()
+
+    def _init_transients(self) -> None:
+        self._fold_cache: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self._fold_lock = threading.Lock()
+        self._event_store = None
+        self._uty: Optional[np.ndarray] = None   # UᵀU for implicit solves
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        for k in ("_fold_cache", "_fold_lock", "_event_store", "_uty"):
+            d.pop(k, None)
+        return d
+
+    def __setstate__(self, d):
+        # Backfill fields a pre-ISSUE-15 pickle lacks, then rebuild the
+        # transient serving state.
+        for f in dataclasses.fields(self):
+            if f.name not in d and f.default is not dataclasses.MISSING:
+                d[f.name] = f.default
+        self.__dict__.update(d)
+        self._init_transients()
 
     def retriever(self) -> Retriever:
         """THE serving route to the item corpus (retrieval facade)."""
         return cached_retriever(self, lambda: Retriever(
             self.item_factors, n_items=len(self.item_index),
             name="similarproduct"))
+
+    def post_load(self, ctx) -> None:
+        """Fold-in attachment point: stash the serving event store so
+        unknown query items can be solved from their recent views."""
+        store = getattr(ctx, "event_store", None)
+        if store is not None:
+            self._event_store = store
+
+    def fold_in_item(self, item: str) -> Optional[np.ndarray]:
+        """L2-normalized folded factor for an UNKNOWN item, solved from
+        the KNOWN users who recently viewed it; None when fold-in is
+        off, no event store/user factors are attached, or the item has
+        no usable views.  Bounded per-generation LRU — dies with the
+        wrapper on reload/rollback, exactly when the user factors it was
+        solved against do."""
+        import time as _time
+
+        es = getattr(self, "_event_store", None)
+        uf = getattr(self, "user_factors", None)
+        uidx = getattr(self, "user_index", None)
+        app = getattr(self, "app_name", None)
+        if es is None or uf is None or uidx is None or not app \
+                or not _item_fold_in_enabled():
+            return None
+        with self._fold_lock:
+            hit = self._fold_cache.get(item)
+            if hit is not None:
+                vec, t = hit
+                if vec is not None or \
+                        _time.monotonic() - t < _ITEM_FOLD_NEG_TTL_S:
+                    self._fold_cache.move_to_end(item)
+                    _item_fold_metric().inc(result="cached")
+                    return vec
+                del self._fold_cache[item]
+        from predictionio_tpu.models import als as _als
+        from predictionio_tpu.obs import span
+
+        try:
+            with span("fold_in_item", item=item):
+                events = es.find(
+                    app, entity_type="user", target_entity_type="item",
+                    target_entity_id=item,
+                    event_names=list(self.fold_event_names) or None,
+                    limit=_env_int("PIO_FOLD_IN_EVENTS", 50),
+                    reversed=True)
+                events = list(events)
+        except Exception:
+            logging.getLogger(__name__).debug(
+                "item fold-in event read failed", exc_info=True)
+            _item_fold_metric().inc(result="unavailable")
+            return None
+        ids = [int(uidx[ev.entity_id]) for ev in events
+               if ev.entity_id in uidx]
+        if not ids:
+            self._fold_store(item, None)
+            _item_fold_metric().inc(result="no_events")
+            return None
+        if self._uty is None:
+            f = np.asarray(uf, np.float64)
+            self._uty = f.T @ f
+        # The item-side normal equation is the user-side one with roles
+        # swapped: implicit views (r=1) against the frozen user factors.
+        vec = _als.fold_in(
+            np.asarray(uf), np.asarray(ids),
+            np.ones(len(ids), np.float32),
+            reg=float(getattr(self, "reg", 0.01)),
+            alpha=float(getattr(self, "alpha", 1.0)),
+            implicit=True, yty=self._uty)
+        norm = float(np.linalg.norm(vec))
+        vec = vec / (norm if norm > 1e-9 else 1.0)  # corpus is normalized
+        self._fold_store(item, vec)
+        _item_fold_metric().inc(result="solved")
+        return vec
+
+    def _fold_store(self, item: str, vec: Optional[np.ndarray]) -> None:
+        import time as _time
+
+        with self._fold_lock:
+            self._fold_cache[item] = (vec, _time.monotonic())
+            self._fold_cache.move_to_end(item)
+            cap = _env_int("PIO_FOLD_IN_CACHE", 10000)
+            while len(self._fold_cache) > max(cap, 1):
+                self._fold_cache.popitem(last=False)
 
 
 class ALSAlgorithm(Algorithm):
@@ -160,18 +324,45 @@ class ALSAlgorithm(Algorithm):
             item_factors=f,
             item_index=prepared_data.item_index,
             item_categories=prepared_data.item_categories,
+            # Item-side fold-in context (ISSUE 15): the RAW user factors
+            # (fold-in solves in raw factor space; only the corpus is
+            # normalized) + where this generation's view events live.
+            user_factors=np.asarray(
+                model.user_factors)[: len(prepared_data.user_index)],
+            user_index=prepared_data.user_index,
+            app_name=getattr(prepared_data, "app_name", None),
+            fold_event_names=tuple(
+                getattr(prepared_data, "event_names", ()) or ("view",)),
+            reg=float(p.lambda_),
+            alpha=float(p.alpha),
         )
 
     def predict(self, model: SimilarProductModel, query: Query) -> PredictedResult:
         known = [model.item_index[i] for i in query.items
                  if i in model.item_index]
-        if not known:
+        # Item-side fold-in (ISSUE 15): a query item this generation has
+        # never trained on (a brand-new product with a few views) gets a
+        # serve-time folded factor and contributes to the query vector
+        # like any trained item, instead of silently dropping out.
+        folded: List[np.ndarray] = []
+        for i in query.items:
+            if i not in model.item_index:
+                vec = model.fold_in_item(i)
+                if vec is not None:
+                    folded.append(vec)
+        if not known and not folded:
             return PredictedResult(itemScores=[])
         # Host fast path (cf. recommendation template): factors are
         # host-resident numpy; one matmul row beats a device dispatch
         # round-trip for any single query.
         f = model.item_factors
-        q = f[np.asarray(known)].sum(axis=0, keepdims=True)  # [1, K]
+        parts = []
+        if known:
+            parts.append(f[np.asarray(known)].sum(axis=0))
+        if folded:
+            parts.append(np.sum(folded, axis=0))
+        q = np.sum(parts, axis=0, keepdims=True) \
+            if len(parts) > 1 else parts[0][None, :]  # [1, K]
 
         n_items = f.shape[0]
         exclude = np.zeros((1, n_items), dtype=bool)

@@ -21,6 +21,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import os
 import pickle
 import traceback
 from typing import Any, List, Optional, Sequence, Tuple
@@ -334,11 +335,24 @@ def run_evaluation(
     *,
     evaluation_class: str = "",
     params_generator_class: str = "",
+    checkpoint_dir: Optional[str] = None,
 ) -> Tuple[str, MetricEvaluatorResult]:
     """Sweep engine-params candidates and score them (reference:
-    CoreWorkflow.runEvaluation + MetricEvaluator.evaluateBase, §3.4)."""
+    CoreWorkflow.runEvaluation + MetricEvaluator.evaluateBase, §3.4).
+
+    ``checkpoint_dir`` (ISSUE 15 satellite; default
+    ``PIO_EVAL_CHECKPOINT_DIR``) makes the sweep preemption-safe: each
+    completed (candidate, fold) unit persists as it finishes, a SIGTERM
+    mid-sweep marks the instance EVALPREEMPTED and propagates
+    ``TrainPreempted`` (the CLI exits 143, same contract as training),
+    and rerunning the same command resumes from the completed units —
+    which are cleared once the sweep lands."""
+    from predictionio_tpu.controller.engine import EvalCheckpoint
+
     ctx = ctx or RuntimeContext.create()
     storage: Storage = ctx.storage
+    ck_dir = checkpoint_dir or os.environ.get("PIO_EVAL_CHECKPOINT_DIR")
+    checkpoint = EvalCheckpoint(ck_dir) if ck_dir else None
     instance = EvaluationInstance(
         id=None,
         status="EVALRUNNING",
@@ -354,10 +368,15 @@ def run_evaluation(
         candidates = list(params_generator.engine_params_list)
         if not candidates:
             raise WorkflowError("EngineParamsGenerator produced no candidates.")
+        if checkpoint is not None and checkpoint.completed():
+            logger.info("eval sweep resuming: %d completed "
+                        "(candidate, fold) unit(s) found in %s",
+                        checkpoint.completed(), ck_dir)
         scored: List[Tuple[EngineParams, float, List[float]]] = []
         # Shared-prep sweep: folds are read + prepared once per distinct
         # datasource/preparator config, not once per candidate.
-        all_eval_data = engine.eval_multi(ctx, candidates)
+        all_eval_data = engine.eval_multi(ctx, candidates,
+                                          checkpoint=checkpoint)
         for i, (engine_params, eval_data) in enumerate(
                 zip(candidates, all_eval_data)):
             score = evaluation.metric.calculate(eval_data)
@@ -393,7 +412,16 @@ def run_evaluation(
             }
         )
         instances.update(instance)
+        if checkpoint is not None:
+            checkpoint.clear()  # landed: a rerun is a fresh sweep
         return instance_id, result
+    except TrainPreempted:
+        # SIGTERM mid-sweep: the completed units are on disk and the CLI
+        # owns the exit code — not a failed evaluation.
+        instance.status = "EVALPREEMPTED"
+        instance.end_time = _now()
+        instances.update(instance)
+        raise
     except Exception:
         instance.status = "EVALFAILED"
         instance.end_time = _now()

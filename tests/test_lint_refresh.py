@@ -104,3 +104,64 @@ def test_cli_exit_codes(tmp_path):
     (pkg / "bad.py").write_text(
         "def f(storage, m):\n    storage.get_models().insert(m)\n")
     assert lint_refresh.main([str(tmp_path)]) == 1
+
+
+# -- rule 4 (ISSUE 15): promote loops only inside fleet/ --------------------
+
+def test_detects_promote_loop_outside_fleet():
+    src = """
+def push_everywhere(urls, instance_id):
+    for url in urls:
+        HttpPromoter(url).promote(instance_id)
+"""
+    violations = lint_refresh.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_refresh=False)
+    assert len(violations) == 1
+    assert "RolloutController" in violations[0]
+
+
+def test_detects_promote_comprehension_outside_fleet():
+    src = """
+def push_everywhere(promoters, iid):
+    return [p.promote(iid) for p in promoters]
+"""
+    violations = lint_refresh.check_source(
+        src, "t.py", ("cli", "main.py"), in_refresh=False)
+    assert len(violations) == 1
+
+
+def test_single_promote_outside_loop_is_fine():
+    # the refresh daemon's one promote per cycle is legal — run_once is
+    # CALLED from a loop, but the call is not lexically inside one
+    src = """
+def _promote(self, instance_id):
+    self.promoter.promote(instance_id)
+"""
+    assert lint_refresh.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_refresh=False) == []
+
+
+def test_promote_in_helper_defined_inside_loop_is_fine():
+    # a function DEFINED in a loop body resets the loop context
+    src = """
+def build(urls):
+    out = []
+    for url in urls:
+        def one(iid, _u=url):
+            return HttpPromoter(_u).promote(iid)
+        out.append(one)
+    return out
+"""
+    assert lint_refresh.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_refresh=False) == []
+
+
+def test_fleet_package_may_loop_promote():
+    src = """
+def unwind(promoters, iid):
+    for p in promoters:
+        p.promote(iid)
+"""
+    assert lint_refresh.check_source(
+        src, "t.py", ("fleet", "rollout.py"), in_refresh=False,
+        in_fleet=True) == []

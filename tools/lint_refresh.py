@@ -31,6 +31,14 @@ structural (a tier-1 test runs it in CI):
    to the server's gate, not a daemon-side reimplementation) and may
    not call ``get_models`` at all.
 
+4. **Fleet promotion goes through the rollout controller** (ISSUE 15) —
+   a ``.promote(...)`` call lexically inside a loop (for/while/
+   comprehension) is allowed ONLY inside ``predictionio_tpu/fleet``.
+   A bare promote-loop over an instance list has no wave gate, no
+   journaled state to resume from, and no whole-fleet unwind; the
+   single-instance daemon's one ``promoter.promote(...)`` per cycle
+   (not lexically in a loop) stays legal.
+
 Usage: ``python tools/lint_refresh.py [root]`` — prints violations and
 exits non-zero when any exist.
 """
@@ -53,6 +61,35 @@ _GEN_ATTRS = {"_models", "_algorithms", "_serving", "_instance",
 _GEN_WRITE_OK = {("server", "engine_server.py")}
 # Names the refresh package may not touch (rule 3).
 _REFRESH_FORBIDDEN = {"get_models", "validate_model_finite"}
+# Package whose loops MAY call .promote() (rule 4).
+_PROMOTE_LOOP_OK_PKG = "fleet"
+
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _promote_calls_in_loops(tree: ast.AST) -> List[int]:
+    """Line numbers of ``<x>.promote(...)`` calls lexically inside a
+    loop or comprehension (rule 4)."""
+    out: List[int] = []
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(child, _LOOP_NODES)
+            if (in_loop and isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "promote"):
+                out.append(child.lineno)
+            # a nested function body resets the loop context — a helper
+            # DEFINED in a loop is not itself a promote loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                walk(child, False)
+            else:
+                walk(child, child_in_loop)
+
+    walk(tree, False)
+    return out
 
 
 def _rel_key(path: Path) -> tuple:
@@ -84,7 +121,8 @@ def _get_models_bound_names(tree: ast.AST) -> set:
 
 
 def check_source(source: str, filename: str,
-                 rel_key: tuple, in_refresh: bool) -> List[str]:
+                 rel_key: tuple, in_refresh: bool,
+                 in_fleet: bool = False) -> List[str]:
     violations: List[str] = []
     try:
         tree = ast.parse(source, filename=filename)
@@ -94,6 +132,15 @@ def check_source(source: str, filename: str,
     model_write_ok = rel_key in _MODEL_WRITE_OK \
         or rel_key[0] == "storage"
     bound = _get_models_bound_names(tree)
+    # Rule 4: promote loops only inside the fleet package.
+    if not in_fleet:
+        for lineno in _promote_calls_in_loops(tree):
+            violations.append(
+                f"{filename}:{lineno}: .promote() inside a loop — "
+                f"multi-instance promotion goes through "
+                f"fleet.RolloutController (wave gating, journaled "
+                f"state, whole-fleet rollback), never a bare promote "
+                f"loop over an instance list")
     for node in ast.walk(tree):
         # Rule 1: model-store writes.
         if isinstance(node, ast.Call) and not model_write_ok:
@@ -147,8 +194,10 @@ def check(root: Path | str | None = None) -> List[str]:
     for path in sorted(pkg.rglob("*.py")):
         rel = _rel_key(path)
         in_refresh = path.parent.name == "refresh"
+        in_fleet = _PROMOTE_LOOP_OK_PKG in path.parts
         violations.extend(check_source(
-            path.read_text(encoding="utf-8"), str(path), rel, in_refresh))
+            path.read_text(encoding="utf-8"), str(path), rel, in_refresh,
+            in_fleet))
     return violations
 
 

@@ -609,6 +609,41 @@ _RETR_METRIC_RE = re.compile(
     r'^(pio_retrieval_requests_total|pio_retrieval_candidates_total)'
     r'\{([^}]*)\} (\S+)$')
 
+_RECALL_METRIC_RE = re.compile(
+    r'^(pio_retrieval_recall(?:_baseline|_scanned_fraction'
+    r'|_shortlist_saturation|_cell_miss|_captures_total)?)'
+    r'\{([^}]*)\} (\S+)$')
+
+
+def _scrape_recall(port: int):
+    """Online sampled-recall gauges by rung (ISSUE 16) from the live
+    exposition — the artifact records what an operator's scrape would
+    actually see, not an in-process shortcut."""
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    rungs, counts = {}, {}
+    for line in text.splitlines():
+        m = _RECALL_METRIC_RE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.group(1), dict(
+            kv.split("=") for kv in
+            m.group(2).replace('"', "").split(",") if "=" in kv), \
+            float(m.group(3))
+        if name == "pio_retrieval_recall_captures_total":
+            counts[labels.get("result", "?")] = int(value)
+            continue
+        row = rungs.setdefault(labels.get("rung", "?"), {})
+        if name == "pio_retrieval_recall":
+            row[f"recall_{labels.get('window', '?')}"] = value
+            row["k"] = int(labels.get("k", 0))
+        elif name == "pio_retrieval_recall_baseline":
+            row["baseline"] = value
+        else:
+            row[name.replace("pio_retrieval_recall_", "")] = value
+    return {"rungs": rungs, "captures": counts}
+
 
 def _scrape_retrieval(port: int):
     """pio_retrieval_* counters by rung (corpus-scale deltas)."""
@@ -702,6 +737,16 @@ def _corpus_scale(args) -> None:
             knobs["PIO_IVF_NPROBE"] = "64"
             knobs["PIO_PQ_RERANK"] = "1024"
         os.environ.update(knobs)
+        # Train-time recall scorecard at the SAME serving knobs (ISSUE
+        # 16): the baked baseline the online monitor compares against —
+        # built here exactly as `pio train` would bake it.
+        from predictionio_tpu.obs.recall import build_recall_scorecard
+
+        t0 = time.perf_counter()
+        wrapper.recall = build_recall_scorecard(
+            users, items, ivf=ivf, pq=pq, sample=64, seed=0,
+            name="bench")
+        scorecard_build_s = round(time.perf_counter() - t0, 1)
         # Offline recall@10 vs exact on a query sample (the latency
         # rounds below are meaningless if recall collapsed).
         sample = users[:64]
@@ -723,7 +768,13 @@ def _corpus_scale(args) -> None:
                            port=0)
         srv.start()
         srv._models = [wrapper]  # serve the synthetic generation
-        entry = {"n_items": n_items, "knobs": knobs, "ivf": {
+        # Re-arm the recall monitor on the swapped-in synthetic wrapper
+        # so the online sampled gauges cover the measured rounds.
+        srv.recall.on_generation(srv._generation, [wrapper])
+        entry = {"n_items": n_items, "knobs": knobs,
+                 "scorecard": (wrapper.recall.summary()
+                               if wrapper.recall else None),
+                 "scorecard_build_s": scorecard_build_s, "ivf": {
             "nlist": ivf.nlist, "nprobe": info["nprobe"],
             "build_s": build_s, "recall_at_10": round(recall, 4),
             "scanned_fraction": round(
@@ -788,6 +839,10 @@ def _corpus_scale(args) -> None:
             }
             entry["rounds"][rung] = res
             print(json.dumps({"scale": n_items, "rung": rung, **res}))
+        # Online sampled recall per approximate rung (ISSUE 16): what a
+        # live scrape of the shipped-default monitor actually shows
+        # after the measured rounds, next to the offline numbers above.
+        entry["online_sampled_recall"] = _scrape_recall(srv.port)
         for k in ("PIO_RETRIEVAL_RUNG", "PIO_SERVE_SHARD_ABOVE",
                   "PIO_PQ_RERANK", "PIO_IVF_NPROBE",
                   "PIO_SERVE_HOST_MACS"):
@@ -1281,6 +1336,256 @@ def _quality_round(args) -> None:
         print(f"wrote {args.out}")
 
 
+def _recall_round(args) -> None:
+    """ISSUE 16 round: (a) the sampled-monitoring overhead record — p99
+    at c=N with recall monitoring at its SHIPPED defaults
+    (PIO_RECALL_SAMPLE=0.05, shadow exact re-rank off-thread) vs
+    PIO_RECALL_SAMPLE=0 on an identical server/model — the ≤5%
+    acceptance; plus an honest worst-case row at full sampling (every
+    request shadow re-ranked exactly — no claim, one shared core); and
+    (b) a DRIVEN recall-rot→rollback episode: a candidate whose IVF
+    index silently lost most of its inverted-list mass (corpus
+    fingerprint intact → index validation passes; scores of returned
+    items barely move → score-drift/shadow checks stay quiet; the de-
+    tuning below makes that calibration explicit) is promoted through
+    the canary gate under load, the RECALL detector trips on both
+    windows against the generation's own baked scorecard, and the
+    existing gate path rolls it back via /admin/rollback — detection
+    latency and zero non-2xx attested."""
+    import urllib.request as ur
+
+    from predictionio_tpu.refresh import RefreshConfig
+    from predictionio_tpu.refresh.daemon import HttpPromoter, RefreshDaemon
+    from predictionio_tpu.server import EngineServer
+    from predictionio_tpu.server import engine_server as es_mod
+    from predictionio_tpu.controller import RuntimeContext
+
+    # The bench corpus (4000 items) sits below the production IVF
+    # threshold: force the approximate rung so there IS a recall surface
+    # to monitor — same tiny-corpus escape hatch the tests use.
+    os.environ["PIO_IVF"] = "on"
+    os.environ["PIO_IVF_MIN_ITEMS"] = "1000"
+    os.environ["PIO_RETRIEVAL_RUNG"] = "ivf"
+    # The episode's verdict must come from the RECALL gate: de-tune the
+    # SLO burn-rate and the PR-11 drift/shadow thresholds so the bench's
+    # own load shape (closed-loop on one shared core) and the candidate
+    # swap's benign score movement can never trip another gate first —
+    # same calibration discipline as the --quality round.
+    os.environ["PIO_SLO_AVAILABILITY"] = "0.9"
+    os.environ["PIO_SLO_LATENCY_TARGET_MS"] = "10000"
+    os.environ["PIO_QUALITY_PSI_THRESHOLD"] = "100"
+    os.environ["PIO_SHADOW_MIN_OVERLAP"] = "0"
+
+    def _mk_server(sample: str):
+        os.environ["PIO_RECALL_SAMPLE"] = sample
+        srv = EngineServer(eng, variant, storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        _drive(srv.port, n_users, args.clients, args.requests)  # warmup
+        return srv
+
+    def _median_rounds(srv, rounds):
+        rounds.sort(key=lambda r: r.get("p99_ms") or 0.0)
+        res = dict(rounds[len(rounds) // 2])
+        res["p99_ms_rounds"] = sorted(r.get("p99_ms") for r in rounds)
+        return res
+
+    eng, variant, storage, n_users = _setup("twotower")
+    ctx = RuntimeContext.create(storage=storage)
+
+    # Phases A/B — sampling OFF (the rate knob, not the kill switch:
+    # the shared draw + sample check stay in the path) vs the shipped
+    # default: THE ≤5% claim.  The closed-loop p99 on this one shared
+    # core is queueing delay whose run-to-run jitter drifts MONOTONICALLY
+    # over a bench's lifetime (>5% between identical back-to-back
+    # drives), so the two configs run on two live servers with their
+    # measured drives INTERLEAVED — the drift lands on both sides — and
+    # the claim compares median-of-4.
+    srv_off = _mk_server("0")
+    srv_def = _mk_server("0.05")
+    # A 2000-request round's p99 is its 20th-worst sample — scheduling
+    # noise; the claim rounds use ≥6000 so the tail statistic itself
+    # stabilizes before the pairing cancels drift.
+    n_meas = max(args.requests, 6000)
+    rounds_off, rounds_def = [], []
+    for _ in range(5):
+        rounds_off.append(_drive(srv_off.port, n_users, args.clients,
+                                 n_meas))
+        rounds_def.append(_drive(srv_def.port, n_users, args.clients,
+                                 n_meas))
+    # The claim estimator is the median PAIRED difference: interleaved
+    # round i of the two servers ran back-to-back, so subtracting
+    # within the pair cancels the drift that dominates any
+    # median-vs-median comparison on this box (a full-sampling phase
+    # routinely measures FASTER than sampling-off by medians alone).
+    paired = sorted(
+        (b.get("p99_ms") or 0.0) - (a.get("p99_ms") or 0.0)
+        for a, b in zip(rounds_off, rounds_def))
+    paired_delta_ms = paired[len(paired) // 2]
+    off = _median_rounds(srv_off, rounds_off)
+    on_default = _median_rounds(srv_def, rounds_def)
+    srv_off.stop()
+    srv_def.stop()
+
+    # Phase C — full sampling worst case (recorded, no claim), and the
+    # server the episode runs on: every request feeds the detector, so
+    # the trip lands within the canary window instead of a bench-length
+    # wait for 0.05-sampled mass.
+    srv = _mk_server("1.0")
+    on_full = _drive(srv.port, n_users, args.clients, args.requests)
+    with ur.urlopen(f"http://127.0.0.1:{srv.port}/quality.json",
+                    timeout=10) as r:
+        qdoc_overhead = json.loads(r.read())
+
+    def _delta(a, b):
+        return (round(100.0 * (b["p99_ms"] - a["p99_ms"]) / a["p99_ms"],
+                      2) if a.get("p99_ms") else None)
+
+    p99_delta_pct = (round(100.0 * paired_delta_ms / off["p99_ms"], 2)
+                     if off.get("p99_ms") else None)
+    p99_delta_full_pct = _delta(off, on_full)
+    healthy_row = ((qdoc_overhead.get("recall") or {})
+                   .get("rungs") or {}).get("ivf") or {}
+
+    # Phase D — the driven recall-rot episode: the candidate's wrapper
+    # unpickles with its healthy baked scorecard, then its IVF index is
+    # swapped for one that kept only the head of every inverted list —
+    # the fingerprint still names the real corpus, so the facade's
+    # index validation passes and only the sampled exact re-rank can
+    # see the lost neighbors.
+    real_load = es_mod.load_models
+
+    def rotten(engine_, instance, c=None):
+        models = real_load(engine_, instance, c)
+        import dataclasses as dc
+
+        idx = models[0].ivf
+        keep = np.maximum(1, idx.list_lengths // 4).astype(np.int32)
+        lists = idx.lists.copy()
+        for ci in range(idx.nlist):
+            lists[ci, keep[ci]:] = -1
+        models[0].ivf = dc.replace(idx, lists=lists, list_lengths=keep)
+        return models
+
+    es_mod.load_models = rotten
+
+    class TimedPromoter(HttpPromoter):
+        t_promoted = None
+        t_rollback = None
+        trip_doc = None
+
+        def promote(self, instance_id):
+            out = super().promote(instance_id)
+            self.t_promoted = time.perf_counter()
+            return out
+
+        def quality_state(self):
+            doc = super().quality_state()
+            if (doc.get("gate") or {}).get("rollback"):
+                self.trip_doc = doc
+            return doc
+
+        def rollback(self):
+            self.t_rollback = time.perf_counter()
+            super().rollback()
+
+    promoter = TimedPromoter(f"http://127.0.0.1:{srv.port}",
+                             canary_window_s=120.0, canary_poll_s=0.2)
+    daemon = RefreshDaemon(
+        eng, variant, ctx,
+        config=RefreshConfig(interval_s=1.0, eval_tolerance=10.0),
+        promoter=promoter)
+    gen_before = json.loads(ur.urlopen(
+        f"http://127.0.0.1:{srv.port}/", timeout=10).read())
+    episode_done = threading.Event()
+    cycle = {}
+
+    def run_cycle():
+        t0 = time.perf_counter()
+        try:
+            cycle.update(daemon.run_once())
+        finally:
+            cycle["wall_s"] = round(time.perf_counter() - t0, 2)
+            episode_done.set()
+
+    drive_box = {}
+    driver = threading.Thread(
+        target=lambda: drive_box.update(_drive_until(
+            srv.port, n_users, args.clients, episode_done,
+            tight_budgets=False)),
+        daemon=True)
+    driver.start()
+    time.sleep(0.5)            # steady state before the promotion
+    run_cycle()
+    driver.join(30)
+    gen_after = json.loads(ur.urlopen(
+        f"http://127.0.0.1:{srv.port}/", timeout=10).read())
+    srv.stop()
+    es_mod.load_models = real_load
+
+    trip = promoter.trip_doc or {}
+    trip_recall = ((trip.get("recall") or {}).get("rungs") or {}) \
+        .get("ivf") or {}
+    non_2xx = sum(n for s, n in drive_box.get("statuses", {}).items()
+                  if not s.startswith("2"))
+    record = {
+        "mode": "recall",
+        "engine": "twotower",
+        "clients": args.clients,
+        "requests_per_phase": args.requests,
+        "gates_detuned_for_episode": {
+            "PIO_SLO_AVAILABILITY": 0.9,
+            "PIO_SLO_LATENCY_TARGET_MS": 10000,
+            "PIO_QUALITY_PSI_THRESHOLD": 100,
+            "PIO_SHADOW_MIN_OVERLAP": 0,
+        },
+        "overhead": {
+            "recall_off": off,
+            "recall_shipped_default": on_default,
+            "recall_full_sampling": on_full,
+            "p99_delta_pct": p99_delta_pct,
+            "p99_delta_within_5pct": (p99_delta_pct is not None
+                                      and p99_delta_pct <= 5.0),
+            "p99_paired_delta_ms_rounds": [round(x, 2) for x in paired],
+            "p99_delta_full_sampling_pct": p99_delta_full_pct,
+        },
+        "healthy_online_recall_ivf": healthy_row,
+        "recall_rot_episode": {
+            "injection": "candidate IVF inverted lists truncated to "
+                         "their head quarter at load (corpus "
+                         "fingerprint intact → validation passes; "
+                         "scorecard baked healthy at train)",
+            "promotion": cycle.get("promotion"),
+            "cycle_wall_s": cycle.get("wall_s"),
+            "detect_to_rollback_s": (
+                round(promoter.t_rollback - promoter.t_promoted, 2)
+                if promoter.t_rollback and promoter.t_promoted else None),
+            "generation_before": gen_before.get("modelGeneration"),
+            "generation_after": gen_after.get("modelGeneration"),
+            "served_instance_restored": (
+                gen_after.get("engineInstanceId")
+                == gen_before.get("engineInstanceId")),
+            "gate_reasons_at_trip": (trip.get("gate") or {})
+            .get("reasons"),
+            "recall_at_trip": {
+                "baseline": trip_recall.get("baseline"),
+                "recall_fast": trip_recall.get("recallFast"),
+                "recall_slow": trip_recall.get("recallSlow"),
+                "n_fast": trip_recall.get("nFast"),
+                "n_slow": trip_recall.get("nSlow"),
+                "tripped_both_windows": bool(trip_recall.get("tripped")),
+            },
+            "query_during_episode": drive_box,
+            "non_2xx_during_episode": non_2xx,
+        },
+    }
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {args.out}")
+
+
 def _fleet_rollout_round(args) -> None:
     """ISSUE 15 round: 3 live engine instances behind a wave rollout,
     with a BAD candidate generation injected at model load — wave 1
@@ -1483,6 +1788,14 @@ def main():
                          "driven drift→rollback episode (score-shifted "
                          "candidate promoted under load, detected by "
                          "the PSI gate, rolled back with zero non-2xx)")
+    ap.add_argument("--recall", action="store_true",
+                    help="ISSUE 16 round: sampled recall-monitoring "
+                         "overhead (shipped defaults vs sampling off, "
+                         "the ≤5%% p99 acceptance) + a driven "
+                         "recall-rot episode (truncated-list IVF "
+                         "candidate promoted under load, the recall "
+                         "gate trips on both windows and rolls back "
+                         "with zero non-2xx)")
     ap.add_argument("--fleet-rollout", dest="fleet_rollout",
                     action="store_true",
                     help="ISSUE 15 round: 3 live instances, a wave "
@@ -1500,6 +1813,9 @@ def main():
         return
     if args.quality:
         _quality_round(args)
+        return
+    if args.recall:
+        _recall_round(args)
         return
     if args.refresh:
         _refresh_round(args)

@@ -296,6 +296,7 @@ def _print_serving_snapshot(lines) -> None:
     staleness = None
     refresh_runs = {}
     quality = {}
+    recall = {}
 
     def _b(model):
         return batcher.setdefault(model, {})
@@ -325,6 +326,18 @@ def _print_serving_snapshot(lines) -> None:
             quality["gate_rollback"] = bool(value)
         elif name == "pio_quality_sampled_total" and value > 0:
             quality["sampled"] = int(value)
+        elif name == "pio_retrieval_recall":
+            if labels.get("window") == "fast":
+                recall.setdefault("rungs", {})[
+                    labels.get("rung", "?")] = value
+                recall["k"] = labels.get("k", "?")
+        elif name == "pio_retrieval_recall_baseline":
+            recall.setdefault("baselines", {})[
+                labels.get("rung", "?")] = value
+        elif name == "pio_retrieval_recall_tripped" and value > 0:
+            recall["tripped"] = True
+        elif name == "pio_retrieval_recall_reporting_only" and value > 0:
+            recall["reporting_only"] = True
         elif name == "pio_model_reload_total":
             reloads[labels.get("result", "?")] = int(value)
         elif name == "pio_breaker_state":
@@ -349,7 +362,7 @@ def _print_serving_snapshot(lines) -> None:
             shed[labels.get("reason", "?")] = int(value)
     if generation is None and not reloads and not breakers and not batcher \
             and not latest_ts and not refresh_runs and staleness is None \
-            and not quality:
+            and not quality and not recall:
         return
     if generation is not None:
         print(f"serving: model generation {generation}")
@@ -386,6 +399,23 @@ def _print_serving_snapshot(lines) -> None:
             parts.append(f"sampled {quality['sampled']}")
         if parts:
             print(f"  quality: {', '.join(parts)}")
+    # Retrieval recall (ISSUE 16): live sampled recall@k per approximate
+    # rung vs the generation's own baked baseline.
+    if recall:
+        parts = []
+        baselines = recall.get("baselines", {})
+        for rung, v in sorted(recall.get("rungs", {}).items()):
+            b = baselines.get(rung)
+            parts.append(f"{rung} {v:.3f}"
+                         + (f" (baseline {b:.3f})" if b is not None
+                            else ""))
+        if recall.get("tripped"):
+            parts.append("RECALL TRIPPED")
+        if recall.get("reporting_only"):
+            parts.append("reporting-only (no trusted recall scorecard)")
+        if parts:
+            k = recall.get("k", "?")
+            print(f"  recall@{k}: {', '.join(parts)}")
     if reloads:
         parts = ", ".join(f"{k}={v}" for k, v in sorted(reloads.items()))
         print(f"  model reloads: {parts}")

@@ -386,6 +386,8 @@ class FleetAggregator:
             for fam, series in merged["histograms"].items()}
         # Quality merge (ISSUE 11): union-of-keys recursion — an
         # instance's field is never silently dropped (tier-1 pinned).
+        # The recall block (ISSUE 16) rides the same merge: counts sum,
+        # recallFast/recallSlow/baseline take the WORST instance (min).
         from predictionio_tpu.obs.quality import merge_quality
 
         return {

@@ -1205,7 +1205,14 @@ class QualityMonitor:
 _SUM_KEYS = frozenset((
     "samples", "scored", "sampledTotal", "emptyTotal", "tracked",
     "hits", "misses", "attributedOnly", "nFast", "nSlow", "n",
+    "captured", "dropped",
 ))
+# Recall fields (ISSUE 16) take the MIN: the fleet's recall IS its worst
+# instance (a rotten replica hides inside a max or a mean), and the
+# baseline pins to the most conservative scorecard in the set.  Flat key
+# names on purpose — psi's fast/slow (drift magnitude) correctly takes
+# MAX, so recall's windows must not share those key names.
+_MIN_KEYS = frozenset(("recallFast", "recallSlow", "baseline"))
 _VERDICT_ORDER = ("healthy", "insufficient", "reporting_only", "degraded")
 
 
@@ -1214,8 +1221,10 @@ def merge_quality(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
 
     Walks the UNION of keys recursively, so no instance's field is ever
     silently dropped (the tier-1 schema-stability test pins this):
-    counts sum, magnitudes take the worst (max), booleans OR, verdicts
-    take the worst of the ordering, strings keep the first non-null.
+    counts sum, magnitudes take the worst (max), recall readings take
+    the worst (MIN — a rotten replica must surface), booleans OR,
+    verdicts take the worst of the ordering, strings keep the first
+    non-null.
     Disabled instances are skipped; all-disabled merges to
     ``{"enabled": False}``."""
     live = [d for d in docs if isinstance(d, dict) and d.get("enabled")]
@@ -1250,6 +1259,8 @@ def _merge_values(key: str, values: List[Any]) -> Any:
         return any(vals)
     if all(isinstance(v, (int, float)) and not isinstance(v, bool)
            for v in vals):
+        if key in _MIN_KEYS:
+            return min(vals)
         if key in _SUM_KEYS:
             return sum(vals)
         return max(vals)

@@ -62,6 +62,7 @@ __all__ = [
     "SERVE_STAGES",
     "WALL_STAGES",
     "Waterfall",
+    "active_sample_u",
     "begin_request",
     "current_waterfall",
     "dispatch_sink",
@@ -279,6 +280,16 @@ def dispatch_sink(wf: Waterfall):
         yield wf
     finally:
         _sink.reset(token)
+
+
+def active_sample_u() -> Optional[float]:
+    """The active collector's shared per-request sample draw (ISSUE 11)
+    — dispatch sink first (the batcher stamps the members' draw onto it),
+    else the request's own waterfall.  None when unsampled or outside any
+    request, so samplers below the facade (retrieval recall capture) cost
+    one contextvar read on the common path."""
+    wf = _sink.get() or _current.get()
+    return wf.sample_u if wf is not None else None
 
 
 def record_stage(stage: str, ms: float, **attrs) -> None:

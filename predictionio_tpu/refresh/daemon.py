@@ -159,9 +159,12 @@ class HttpPromoter:
     @staticmethod
     def _quality_tripped(quality: Dict[str, Any]) -> bool:
         """The server-side quality gate verdict (ISSUE 11): drift over
-        threshold on both windows, or shadow-canary divergence — with
-        the cold-app pass-through and the PIO_QUALITY_GATE switch
-        already applied by the server."""
+        threshold on both windows, shadow-canary divergence, or — since
+        ISSUE 16 — sampled retrieval-recall regression vs the
+        generation's own baked scorecard (``gate.reasons`` carries
+        ``recall_regression``); the cold pass-throughs and the
+        ``PIO_QUALITY_GATE`` / ``PIO_RECALL_GATE`` switches are already
+        applied by the server, so the daemon reads ONE bit."""
         gate = quality.get("gate") or {}
         return bool(gate.get("rollback"))
 

@@ -86,7 +86,8 @@ from predictionio_tpu.retrieval.pq import (
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Retriever", "Plan", "cached_retriever", "iter_hits",
+__all__ = ["Retriever", "Plan", "cached_retriever", "arm_on_create",
+           "iter_hits",
            "build_train_index", "build_train_pq", "IVFIndex",
            "PQCodebook", "build_ivf", "build_pq",
            "corpus_fingerprint", "K_MENU"]
@@ -161,6 +162,13 @@ class Retriever:
         self._pq_dev = None
         self._rerank_dev: Dict = {}
         self._fp: Optional[str] = None
+        # Recall capture hook (ISSUE 16): armed per generation by
+        # ``obs.recall.RecallMonitor`` — called after approximate-rung
+        # answers with (retriever, plan, queries, ids, scanned) so
+        # sampled requests can be exactly re-ranked off-thread.  None
+        # (the default, and whenever PIO_RECALL=off) costs one attribute
+        # read per topk.
+        self.recall_hook = None
         reg = get_registry()
         self._m_requests = reg.counter(
             "pio_retrieval_requests_total",
@@ -550,6 +558,15 @@ class Retriever:
         # the cohort as the rung-tagged "retrieval" stage (⊂ dispatch).
         record_stage("retrieval", ms, rung=p.rung,
                      retrievalCandidates=scanned)
+        hook = self.recall_hook
+        if hook is not None and p.rung in ("ivf", "ivf_pq", "pq_flat"):
+            # Sampled recall capture (ISSUE 16) — the hook does its own
+            # shared-draw sampling and bounded enqueue; it must never be
+            # able to fail a serving answer.
+            try:
+                hook(self, p, q, ids, scanned)
+            except Exception:
+                logger.debug("recall capture failed", exc_info=True)
         info = {"rung": p.rung, "k": p.k, "nprobe": p.nprobe,
                 "rerank": p.rerank, "candidates": scanned, "ms": ms}
         return scores, ids, info
@@ -661,6 +678,7 @@ def iter_hits(scores_row, ids_row, num: int) -> Iterator[Tuple[int, float]]:
 
 _RETRIEVERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _RETRIEVERS_LOCK = threading.Lock()
+_PENDING_ARM: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def cached_retriever(owner, build) -> Retriever:
@@ -671,12 +689,37 @@ def cached_retriever(owner, build) -> Retriever:
     the model pickle."""
     r = _RETRIEVERS.get(owner)
     if r is None:
+        pending = None
         with _RETRIEVERS_LOCK:
             r = _RETRIEVERS.get(owner)
             if r is None:
                 r = build()
                 _RETRIEVERS[owner] = r
+                pending = _PENDING_ARM.pop(owner, None)
+        if pending is not None:
+            try:
+                pending(r)
+            except Exception:
+                logger.debug("retriever arm callback failed",
+                             exc_info=True)
     return r
+
+
+def arm_on_create(owner, fn) -> None:
+    """Run ``fn(retriever)`` for ``owner``'s retriever — immediately if
+    one is already cached, else right after ``cached_retriever`` builds
+    it.  Lets observers (obs/recall.py) attach per-generation hooks
+    WITHOUT forcing retriever creation at model load: creation — and
+    with it index/codebook fingerprint validation — stays lazy on the
+    first query.  At most one pending callback per owner (latest wins);
+    a callback for a swapped-out generation is expected to no-op when
+    it fires."""
+    with _RETRIEVERS_LOCK:
+        r = _RETRIEVERS.get(owner)
+        if r is None:
+            _PENDING_ARM[owner] = fn
+            return
+    fn(r)
 
 
 def build_train_index(item_vecs: np.ndarray, *, name: str,

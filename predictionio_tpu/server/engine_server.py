@@ -38,6 +38,7 @@ from predictionio_tpu.obs import (
 )
 from predictionio_tpu.obs import waterfall as _waterfall
 from predictionio_tpu.obs.quality import SERVE_ID_HEADER, QualityMonitor
+from predictionio_tpu.obs.recall import RecallMonitor
 from predictionio_tpu.obs.slo import SLOConfig, SLOEngine
 from predictionio_tpu.resilience import deadline as _deadline
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
@@ -288,6 +289,12 @@ class EngineServer:
         # drift detection + shadow-scored canary + feedback join, all
         # behind the PIO_QUALITY kill switch (off = inert no-op hooks).
         self.quality = QualityMonitor(registry=reg)
+        # Retrieval-recall layer (ISSUE 16): sampled exact re-rank of
+        # approximate-rung answers vs each generation's own baked recall
+        # scorecard, folded into /quality.json's gate as a third
+        # verdict.  PIO_RECALL=off registers zero instruments and can
+        # never block a promotion.
+        self.recall = RecallMonitor(registry=reg)
 
     def _load_candidate(self, target_instance_id: Optional[str] = None):
         """Storage-read phase of the staged reload (runs under the
@@ -419,6 +426,10 @@ class EngineServer:
             self.quality.on_generation(
                 gen, models, shadow_fn=shadow_fn,
                 prev_generation=prev.number if retained else None)
+            # Recall re-anchor (ISSUE 16): arm the NEW generation's
+            # retriever hook and judge it against its own baked recall
+            # scorecard (never the predecessor's).
+            self.recall.on_generation(gen, models)
             self._arm_eviction(gen)
             self._record_reload("ok", instance=instance.id, generation=gen)
             logger.info("Engine server loaded instance %s (generation %d)",
@@ -454,6 +465,7 @@ class EngineServer:
             # generation it was judging is out) and re-anchors drift on
             # the RESTORED generation's own scorecard.
             self.quality.on_generation(gen, restored_models)
+            self.recall.on_generation(gen, restored_models)
             # The rolled-from generation now sits in the previous slot;
             # it ages out on the same TTL as any other retained one.
             self._arm_eviction(gen)
@@ -671,8 +683,12 @@ class EngineServer:
                 # Model-quality document (ISSUE 11): drift vs the
                 # training scorecard, shadow-canary divergence, online
                 # hit-rate, and the promotion-gate verdict the refresh
-                # daemon polls during the canary window.
-                return 200, self.quality.payload()
+                # daemon polls during the canary window.  The recall
+                # layer (ISSUE 16) folds its verdict into the same gate
+                # — the daemon/rollout read only gate.rollback, so a
+                # recall regression rolls back through the existing path.
+                return 200, self.recall.augment_quality(
+                    self.quality.payload())
             if path == "/traces.json" and method == "GET":
                 # ?request_id= resolves waterfall exemplars to ONE trace;
                 # ?min_ms=/?limit= bound the view (shared helper).
@@ -757,7 +773,8 @@ class EngineServer:
                     # PIO_REQUEST_LOG_SAMPLE wide-event sampler all
                     # compare this same u against their own rates.
                     u = self.quality.draw() if self.quality.enabled \
-                        else None
+                        else (self.recall.draw()
+                              if self.recall.enabled else None)
                     if wf is not None and u is not None:
                         wf.sample_u = u
                     try:
@@ -896,4 +913,5 @@ class EngineServer:
             self._evict_timer = None
         self.scheduler.close()
         self.quality.close()
+        self.recall.close()
         self.plugins.stop()

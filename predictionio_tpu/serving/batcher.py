@@ -248,6 +248,15 @@ class MicroBatcher:
         # to every member's waterfall below — one corpus scan, one shared
         # "retrieval" reading per cohort.
         sink = Waterfall()
+        # The cohort shares ONE retrieval scan, so it shares one recall
+        # sampling decision: carry the first member's per-request draw
+        # (ISSUE 11 shared-u contract) onto the dispatch sink, where the
+        # retrieval facade's recall capture reads it.
+        for e in live:
+            wf = e.waterfall
+            if wf is not None and wf.sample_u is not None:
+                sink.sample_u = wf.sample_u
+                break
         t0 = self.clock.now()
         # queue_wait/batch_wait are fully determined at dispatch start —
         # stamp them NOW, on every outcome path (success, failure, retry),
@@ -344,6 +353,8 @@ class MicroBatcher:
             t1 = self.clock.now()
             try:
                 sink = Waterfall()
+                if e.waterfall is not None:
+                    sink.sample_u = e.waterfall.sample_u
                 with dispatch_sink(sink):
                     results, generation = self.dispatch_fn([e.query])
                 if e.waterfall is not None:

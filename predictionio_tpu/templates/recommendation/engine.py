@@ -40,6 +40,10 @@ from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import als as als_lib
 from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
+from predictionio_tpu.obs.recall import (
+    RecallScorecard,
+    build_recall_scorecard,
+)
 from predictionio_tpu.retrieval import (
     IVFIndex,
     PQCodebook,
@@ -378,6 +382,12 @@ class ALSModelWrapper:
     # same atomic-swap contract as ``ivf`` — serving drift is judged
     # against THIS generation's own baseline.
     quality: Optional[Scorecard] = None
+    # Training-time expected-recall baseline (ISSUE 16): offline
+    # recall@k of THIS generation's own ivf/pq structures on a seeded
+    # query sample — the online recall monitor trips on regression vs
+    # this, never an absolute floor.  None when neither structure was
+    # built (exact serving).  Old pickles backfill via __setstate__.
+    recall: Optional[RecallScorecard] = None
     # Fold-in context (ISSUE 10), persisted with the generation.
     app_name: Optional[str] = None
     fold_event_names: Sequence[str] = ()
@@ -728,21 +738,28 @@ class ALSAlgorithm(Algorithm):
         # explicit PIO_IVF=on, never auto.
         ivf_idx = build_train_index(itf_host, name="als", seed=cfg.seed,
                                     require_explicit=True)
+        # Residual PQ codes (ISSUE 13): auto-gated like the deep
+        # templates — the exact re-rank makes quantization safe for
+        # norm-variant factors, so no explicit opt-in is required.
+        pq = build_train_pq(itf_host, name="als", ivf=ivf_idx,
+                            seed=cfg.seed)
         return ALSModelWrapper(
             model=model,
             user_index=prepared_data.user_index,
             item_index=prepared_data.item_index,
             ivf=ivf_idx,
-            # Residual PQ codes (ISSUE 13): auto-gated like the deep
-            # templates — the exact re-rank makes quantization safe for
-            # norm-variant factors, so no explicit opt-in is required.
-            pq=build_train_pq(itf_host, name="als", ivf=ivf_idx,
-                              seed=cfg.seed),
+            pq=pq,
             # Quality baseline (ISSUE 11): top-K reconstruction scores
             # of a seeded user sample against the item factors — the
             # population serving's itemScores come from.
             quality=scorecard_from_matrix(uf_host, itf_host,
                                           seed=cfg.seed or 0, name="als"),
+            # Expected-recall baseline (ISSUE 16): offline recall of the
+            # structures just built, through the same search paths and
+            # nprobe/rerank formulas serving will use.
+            recall=build_recall_scorecard(uf_host, itf_host, ivf=ivf_idx,
+                                          pq=pq, seed=cfg.seed or 0,
+                                          name="als"),
             # Fold-in context (ISSUE 10): where this generation's events
             # live + the solve hyper-parameters it was trained with, so
             # serve-time fold-in solves the SAME normal equation the

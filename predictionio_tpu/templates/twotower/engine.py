@@ -34,6 +34,10 @@ from predictionio_tpu.controller.params import Params
 from predictionio_tpu.data.event import BiMap
 from predictionio_tpu.models import two_tower as tt_lib
 from predictionio_tpu.obs.quality import Scorecard, scorecard_from_matrix
+from predictionio_tpu.obs.recall import (
+    RecallScorecard,
+    build_recall_scorecard,
+)
 from predictionio_tpu.retrieval import (
     IVFIndex,
     PQCodebook,
@@ -142,6 +146,11 @@ class TwoTowerModelWrapper:
     # judged against THIS generation's own baseline, fingerprint-pinned
     # to the corpus it was scored over.
     quality: Optional[Scorecard] = None
+    # Training-time expected-recall baseline (ISSUE 16): offline
+    # recall@k of THIS generation's own ivf/pq structures on a seeded
+    # query sample, fingerprint-pinned like ``quality`` — the online
+    # recall monitor trips on regression vs this, not an absolute floor.
+    recall: Optional[RecallScorecard] = None
     # Warm-start carry (ISSUE 10): the host-numpy train state + the
     # config it was trained under + the interaction count — what the
     # next refresh needs to CONTINUE training on a delta window instead
@@ -150,6 +159,15 @@ class TwoTowerModelWrapper:
     train_state: Optional[Dict] = None
     train_cfg: Optional[tt_lib.TwoTowerConfig] = None
     n_examples: int = 0
+
+    def __setstate__(self, d):
+        """Old-pickle backfill: wrappers serialized before newer
+        optional fields existed (``recall``, …) restore with every
+        missing field at its dataclass default."""
+        for f in dataclasses.fields(self):
+            if f.name not in d and f.default is not dataclasses.MISSING:
+                d[f.name] = f.default
+        self.__dict__.update(d)
 
     def retriever(self) -> Retriever:
         """THE serving route to the item corpus (retrieval facade):
@@ -225,21 +243,30 @@ class TwoTowerAlgorithm(Algorithm):
         # generation swap moves both atomically.
         ivf = build_train_index(item_vecs, name="twotower",
                                 seed=cfg.seed)
+        # Residual PQ codes (policy-gated: PIO_PQ / PIO_PQ_M /
+        # PIO_PQ_MIN_ITEMS), built on top of the IVF coarse structure
+        # and swapped with it.
+        pq = build_train_pq(item_vecs, name="twotower", ivf=ivf,
+                            seed=cfg.seed)
         return TwoTowerModelWrapper(
             user_vecs=user_vecs, item_vecs=item_vecs,
             user_index=user_index,
             item_index=item_index,
             ivf=ivf,
-            # Residual PQ codes (policy-gated: PIO_PQ / PIO_PQ_M /
-            # PIO_PQ_MIN_ITEMS), built on top of the IVF coarse
-            # structure and swapped with it.
-            pq=build_train_pq(item_vecs, name="twotower", ivf=ivf,
-                              seed=cfg.seed),
+            pq=pq,
             # Quality baseline (ISSUE 11): top-K scores of a seeded user
             # sample against the full corpus — the same population
             # serving emits, so serve-time PSI compares like with like.
             quality=scorecard_from_matrix(user_vecs, item_vecs,
                                           seed=cfg.seed or 0,
+                                          name="twotower"),
+            # Expected-recall baseline (ISSUE 16): offline recall of the
+            # structures just built, through the same search paths and
+            # nprobe/rerank formulas serving will use.  None when
+            # neither structure was built (exact serving — nothing to
+            # monitor).
+            recall=build_recall_scorecard(user_vecs, item_vecs, ivf=ivf,
+                                          pq=pq, seed=cfg.seed or 0,
                                           name="twotower"),
             train_state=tt_lib.state_to_host(state),
             train_cfg=cfg,

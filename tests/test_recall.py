@@ -1,0 +1,778 @@
+"""Online retrieval-recall observability (ISSUE 16): shadow exact
+re-rank sampling, per-rung recall scorecards, and the gate-wired recall
+drift detector.
+
+Acceptance spine: a healthy server's online sampled recall@10 sits
+within tolerance of its own baked scorecard baseline; a regression vs
+that baseline trips on BOTH windows and folds ``recall_regression``
+into the ``/quality.json`` gate the daemon/rollout already poll;
+``PIO_RECALL=off`` registers zero instruments and can never block a
+promotion; the scorecard rides both wrappers' pickles (old pickles
+backfill); a corpus-fingerprint mismatch degrades to reporting-only;
+the fleet merge carries the new fields with worst-instance (MIN)
+semantics and never silently drops a key.  Detector tests ride
+injectable clocks — zero wall sleeps.
+"""
+
+import dataclasses
+import json
+import pickle
+import time
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.obs.quality import merge_quality
+from predictionio_tpu.obs.recall import (
+    RecallConfig,
+    RecallDetector,
+    RecallMonitor,
+    RecallScorecard,
+    build_recall_scorecard,
+    resolve_recall_scorecard,
+)
+from predictionio_tpu.obs import waterfall as wfm
+from predictionio_tpu.retrieval import Retriever, cached_retriever
+from predictionio_tpu.retrieval.ivf import build_ivf, corpus_fingerprint
+from predictionio_tpu.retrieval.pq import build_pq
+from predictionio_tpu.workflow.core_workflow import load_models, run_train
+
+RECALL_METRICS = (
+    "pio_retrieval_recall",
+    "pio_retrieval_recall_baseline",
+    "pio_retrieval_recall_captures_total",
+    "pio_retrieval_recall_scanned_fraction",
+    "pio_retrieval_recall_shortlist_saturation",
+    "pio_retrieval_recall_cell_miss",
+    "pio_retrieval_recall_tripped",
+    "pio_retrieval_recall_reporting_only",
+)
+
+
+def _cfg(**kw) -> RecallConfig:
+    base = dict(sample=1.0, k=10, fast_window=64, reservoir=256,
+                min_samples=10, tolerance=0.05, recovery_s=30.0)
+    base.update(kw)
+    return RecallConfig(**base)
+
+
+def _corpus(n=3000, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    it = rng.standard_normal((n, d)).astype(np.float32)
+    qv = rng.standard_normal((max(n // 10, 64), d)).astype(np.float32)
+    return it, qv
+
+
+def _structures(it, nlist=32, m=4):
+    ivf = build_ivf(it, nlist=nlist, force=True)
+    pq = build_pq(it, m=m, ivf=ivf)
+    return ivf, pq
+
+
+# ==========================================================================
+# Scorecard build + resolve
+# ==========================================================================
+
+class TestRecallScorecard:
+    def test_build_covers_rungs_and_pins_fingerprint(self):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, pq=pq, seed=0,
+                                    name="t")
+        assert set(sc.recall) == {"ivf", "ivf_pq", "pq_flat"}
+        for table in sc.recall.values():
+            assert set(table) == {1, 10}
+            for v in table.values():
+                assert 0.0 <= v <= 1.0
+        assert sc.fingerprint == corpus_fingerprint(it)
+        assert sc.n_queries > 0
+        # exact-k lookup plus the nearest-k fallback
+        assert sc.expected("ivf", 10) == sc.recall["ivf"][10]
+        assert sc.expected("ivf", 50) == sc.recall["ivf"][10]
+        assert sc.expected("nope", 10) is None
+
+    def test_build_seeded_deterministic(self):
+        it, qv = _corpus()
+        ivf, _ = _structures(it)
+        a = build_recall_scorecard(qv, it, ivf=ivf, seed=3)
+        b = build_recall_scorecard(qv, it, ivf=ivf, seed=3)
+        assert a.recall == b.recall
+
+    def test_no_approximate_structure_builds_none(self):
+        # tiny corpora serve exact — nothing to monitor, no scorecard
+        it, qv = _corpus(n=100)
+        assert build_recall_scorecard(qv, it) is None
+
+    def test_pickle_round_trip(self):
+        it, qv = _corpus()
+        ivf, _ = _structures(it)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, seed=0)
+        clone = pickle.loads(pickle.dumps(sc))
+        assert clone == sc
+        assert clone.expected("ivf", 10) == sc.recall["ivf"][10]
+
+    def test_resolve_fingerprint_mismatch_reporting_only(self):
+        it, qv = _corpus()
+        ivf, _ = _structures(it)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, seed=0)
+        w = type("W", (), {"recall": sc, "item_vecs": it})()
+        got, reason = resolve_recall_scorecard([w])
+        assert got is sc and reason is None
+        w.item_vecs = it * 2.0   # corpus mutated after training
+        got, reason = resolve_recall_scorecard([w])
+        assert got is None and reason == "fingerprint_mismatch"
+        assert resolve_recall_scorecard([object()]) == (
+            None, "no_scorecard")
+
+
+# ==========================================================================
+# Wrapper serialization (both templates)
+# ==========================================================================
+
+TT_VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.twotower:engine",
+    "datasource": {"params": {"appName": "app"}},
+    "algorithms": [{"name": "twotower",
+                    "params": {"embedDim": 8, "hiddenDims": [16],
+                               "outDim": 8, "epochs": 2, "batchSize": 32,
+                               "seed": 1}}],
+}
+
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _mk_app(ctx, name="app"):
+    storage = ctx.storage
+    app_id = storage.get_apps().insert(App(id=None, name=name))
+    storage.get_events().init(app_id)
+    return app_id
+
+
+def _view(u, i):
+    return Event(event="view", entity_type="user", entity_id=f"u{u}",
+                 target_entity_type="item", target_entity_id=f"i{i}")
+
+
+def _seed_views(ctx, app_id, n_users=10, n_items=40):
+    evs = [_view(u, i) for u in range(n_users) for i in range(n_items)
+           if i % 2 == u % 2]
+    ctx.storage.get_events().insert_batch(evs, app_id)
+
+
+def _tt():
+    from predictionio_tpu.templates.twotower import engine
+
+    return engine(), EngineVariant.from_dict(TT_VARIANT)
+
+
+def _ivf_env(monkeypatch):
+    # Tiny-corpus escape hatch: force the train-time IVF build below the
+    # production threshold so the approximate rung (and therefore the
+    # recall scorecard) exists at test scale.
+    monkeypatch.setenv("PIO_IVF", "on")
+    monkeypatch.setenv("PIO_IVF_MIN_ITEMS", "16")
+
+
+class TestScorecardOnWrappers:
+    def test_twotower_train_bakes_recall_and_pickle_keeps_it(
+            self, ctx, monkeypatch):
+        _ivf_env(monkeypatch)
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        iid = run_train(eng, variant, ctx)
+        wrapper = load_models(
+            eng, ctx.storage.get_engine_instances().get(iid), ctx)[0]
+        sc = wrapper.recall
+        assert isinstance(sc, RecallScorecard)
+        assert "ivf" in sc.recall
+        assert sc.fingerprint == corpus_fingerprint(
+            np.ascontiguousarray(wrapper.item_vecs, dtype=np.float32))
+        clone = pickle.loads(pickle.dumps(wrapper))
+        assert clone.recall == sc     # model+scorecard = ONE artifact
+        got, reason = resolve_recall_scorecard([clone])
+        assert got == sc and reason is None
+
+    def test_als_wrapper_carries_and_pickles_recall(self):
+        from predictionio_tpu.data.event import BiMap
+        from predictionio_tpu.models.als import ALSModel
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSModelWrapper,
+        )
+
+        it, qv = _corpus(n=400, d=8, seed=5)
+        ivf = build_ivf(it, nlist=8, force=True)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, seed=0, name="als")
+        w = ALSModelWrapper(
+            model=ALSModel(user_factors=qv, item_factors=it, rank=8,
+                           implicit=True),
+            user_index=BiMap({f"u{i}": i for i in range(len(qv))}),
+            item_index=BiMap({f"i{i}": i for i in range(len(it))}),
+            ivf=ivf, recall=sc)
+        clone = pickle.loads(pickle.dumps(w))
+        assert clone.recall == sc
+
+    def test_old_pickles_backfill_recall_on_both_wrappers(self):
+        from predictionio_tpu.templates.recommendation.engine import (
+            ALSModelWrapper,
+        )
+        from predictionio_tpu.templates.twotower.engine import (
+            TwoTowerModelWrapper,
+        )
+
+        for cls in (TwoTowerModelWrapper, ALSModelWrapper):
+            # a pre-ISSUE-16 pickle: required fields only, no 'recall'
+            state = {f.name: None for f in dataclasses.fields(cls)
+                     if f.default is dataclasses.MISSING}
+            assert "recall" not in state
+            w = cls.__new__(cls)
+            w.__setstate__(state)
+            assert w.recall is None, cls.__name__
+
+
+# ==========================================================================
+# Detector (fake clock, zero wall sleeps)
+# ==========================================================================
+
+def _scorecard(baseline=0.9, rungs=("ivf",)):
+    return RecallScorecard(
+        recall={r: {1: baseline, 10: baseline} for r in rungs},
+        n_queries=128, fingerprint="fp")
+
+
+class TestRecallDetector:
+    def test_healthy_stream_never_trips(self):
+        det = RecallDetector(_cfg(), _scorecard(0.9), clock=lambda: 0.0)
+        for _ in range(200):
+            det.add("ivf", 0.9)
+        s = det.tick(force=True)
+        assert not s["tripped"]
+        assert s["rungs"]["ivf"]["recallFast"] == pytest.approx(0.9)
+        assert s["rungs"]["ivf"]["baseline"] == pytest.approx(0.9)
+
+    def test_regression_trips_on_both_windows(self):
+        det = RecallDetector(_cfg(), _scorecard(0.9), clock=lambda: 0.0)
+        for _ in range(200):
+            det.add("ivf", 0.6)
+        s = det.tick(force=True)
+        assert s["tripped"]
+        assert s["rungs"]["ivf"]["tripped"]
+
+    def test_fast_burst_alone_does_not_trip(self):
+        # the slow reservoir still holds mostly-healthy mass: one bad
+        # burst must not read as a generation-wide regression
+        det = RecallDetector(_cfg(reservoir=2000), _scorecard(0.9),
+                             clock=lambda: 0.0)
+        for _ in range(1500):
+            det.add("ivf", 0.9)
+        for _ in range(80):      # fills the fast window only
+            det.add("ivf", 0.2)
+        s = det.tick(force=True)
+        r = s["rungs"]["ivf"]
+        assert r["recallFast"] < 0.9 - 0.05
+        assert r["recallSlow"] > 0.9 - 0.05
+        assert not s["tripped"]
+
+    def test_cold_rung_pass_through(self):
+        det = RecallDetector(_cfg(min_samples=100), _scorecard(0.9),
+                             clock=lambda: 0.0)
+        for _ in range(50):      # badly regressed but below the floor
+            det.add("ivf", 0.1)
+        s = det.tick(force=True)
+        assert s["insufficient"] and not s["tripped"]
+
+    def test_hysteresis_clears_only_after_dwell(self):
+        t = [0.0]
+        det = RecallDetector(_cfg(recovery_s=30.0, fast_window=50,
+                                  reservoir=50),
+                             _scorecard(0.9), clock=lambda: t[0])
+        for _ in range(60):
+            det.add("ivf", 0.5)
+        assert det.tick(force=True)["tripped"]
+        for _ in range(200):     # recovered: both windows refill healthy
+            det.add("ivf", 0.9)
+        t[0] += 2.0
+        assert det.tick(force=True)["tripped"], "dwell must hold"
+        t[0] += 31.0
+        assert not det.tick(force=True)["tripped"]
+
+    def test_missing_scorecard_reporting_only(self):
+        det = RecallDetector(_cfg(), None, reporting_reason="no_scorecard",
+                             clock=lambda: 0.0)
+        for _ in range(100):
+            det.add("ivf", 0.0)
+        s = det.tick(force=True)
+        assert s["reportingOnly"] and s["reason"] == "no_scorecard"
+        assert not s["tripped"]
+
+    def test_per_rung_isolation(self):
+        det = RecallDetector(_cfg(), _scorecard(0.9, ("ivf", "ivf_pq")),
+                             clock=lambda: 0.0)
+        for _ in range(100):
+            det.add("ivf", 0.9)
+            det.add("ivf_pq", 0.4)
+        s = det.tick(force=True)
+        assert not s["rungs"]["ivf"]["tripped"]
+        assert s["rungs"]["ivf_pq"]["tripped"]
+        assert s["tripped"]
+
+
+# ==========================================================================
+# Monitor: capture path, kill switch, gate folding
+# ==========================================================================
+
+class _Wrap:
+    def __init__(self, it, ivf=None, pq=None, sc=None):
+        self.item_vecs = it
+        self.recall = sc
+        self._r = Retriever(it, ivf=ivf, pq=pq, name="t")
+        # Register in the facade's retriever cache like a real wrapper
+        # would, so `arm_on_create` sees it as already-built.
+        cached_retriever(self, lambda: self._r)
+
+    def retriever(self):
+        return cached_retriever(self, lambda: self._r)
+
+
+def _drive(retriever, qv, n, batch=4, u=0.0, rung="ivf_pq",
+           monkeypatch=None):
+    if monkeypatch is not None:
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", rung)
+    for i in range(n):
+        sink = wfm.Waterfall()
+        sink.sample_u = u
+        with wfm.dispatch_sink(sink):
+            s, ids, info = retriever.topk(
+                qv[(i * batch) % len(qv):(i * batch) % len(qv) + batch],
+                10)
+    return info
+
+
+class TestRecallMonitor:
+    def test_capture_score_payload_healthy(self, pio_home, monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, pq=pq, seed=0)
+        w = _Wrap(it, ivf, pq, sc)
+        mon = RecallMonitor(_cfg(min_samples=5))
+        mon.on_generation(1, [w])
+        assert w._r.recall_hook is not None
+        info = _drive(w._r, qv, 30, monkeypatch=monkeypatch)
+        assert info["rung"] == "ivf_pq"
+        while mon.drain_once():
+            pass
+        doc = mon.payload()
+        row = doc["rungs"]["ivf_pq"]
+        assert row["nFast"] >= 5 and row["baseline"] is not None
+        # live recall of the same structures matches their own baseline
+        assert abs(row["recallFast"] - row["baseline"]) < 0.1
+        assert doc["verdict"] == "healthy" and not doc["tripped"]
+        # miss attribution + scanned fraction populated for the PQ rung
+        assert row["scannedFraction"] is not None
+        assert row["cellMiss"] is not None
+        assert row["shortlistSaturation"] is not None
+        mon.close()
+
+    def test_unsampled_requests_never_enqueue(self, pio_home,
+                                              monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        w = _Wrap(it, ivf, pq, build_recall_scorecard(qv, it, ivf=ivf,
+                                                      pq=pq))
+        mon = RecallMonitor(_cfg(sample=0.05))
+        mon.on_generation(1, [w])
+        _drive(w._r, qv, 10, u=0.5, monkeypatch=monkeypatch)  # u > rate
+        assert mon.drain_once() == 0
+        # and with no active waterfall at all (sample_u None) — no-op
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "ivf_pq")
+        w._r.topk(qv[:4], 10)
+        assert mon.drain_once() == 0
+        mon.close()
+
+    def test_queue_bound_drops_never_blocks(self, pio_home,
+                                            monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        w = _Wrap(it, ivf, pq, None)
+        mon = RecallMonitor(_cfg(queue=2))
+        mon.on_generation(1, [w])
+        # stall the worker by submitting faster than we drain: call the
+        # hook directly so nothing drains in between
+        hook = w._r.recall_hook
+        sink = wfm.Waterfall()
+        sink.sample_u = 0.0
+        plan = type("P", (), {"rung": "ivf", "k": 10, "nprobe": 2,
+                              "rerank": 0})()
+        mon._thread = type("T", (), {"is_alive": lambda self: True})()
+        with wfm.dispatch_sink(sink):
+            for _ in range(5):
+                hook(w._r, plan, qv[:1],
+                     np.zeros((1, 10), np.int32), 100)
+        reg = get_registry()
+        assert reg.get("pio_retrieval_recall_captures_total") \
+            .value(result="dropped") == 3
+        mon._thread = None
+        mon.close()
+
+    def test_generation_swap_detaches_old_hook_and_drops_stale(
+            self, pio_home, monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        w1, w2 = _Wrap(it, ivf, pq, None), _Wrap(it, ivf, pq, None)
+        mon = RecallMonitor(_cfg())
+        mon.on_generation(1, [w1])
+        mon._thread = type("T", (), {"is_alive": lambda self: True})()
+        _drive(w1._r, qv, 2, monkeypatch=monkeypatch)   # queued, gen 1
+        mon.on_generation(2, [w2])                       # swap clears
+        assert w1._r.recall_hook is None
+        assert w2._r.recall_hook is not None
+        assert mon.drain_once() == 0                     # queue cleared
+        # a capture from the OLD retriever after the swap is stale
+        _drive(w1._r, qv, 1, monkeypatch=monkeypatch)
+        w1._r.recall_hook = mon._capture   # simulate late-armed hook
+        _drive(w1._r, qv, 1, monkeypatch=monkeypatch)
+        assert mon.drain_once() == 1
+        assert get_registry().get("pio_retrieval_recall_captures_total") \
+            .value(result="stale") == 1
+        mon._thread = None
+        mon.close()
+
+    def test_arming_never_forces_retriever_creation(self, pio_home,
+                                                    monkeypatch):
+        # Retriever creation (and with it index fingerprint validation)
+        # is lazy on the first query; the monitor must observe, not
+        # change, that — it arms via arm_on_create, which fires only
+        # when the facade builds the retriever.
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+
+        class LazyWrap:
+            built = 0
+
+            def __init__(self):
+                self.item_vecs = it
+                self.recall = None
+
+            def retriever(self):
+                def build():
+                    self.built += 1
+                    return Retriever(it, ivf=ivf, pq=pq, name="lazy")
+
+                return cached_retriever(self, build)
+
+        w = LazyWrap()
+        mon = RecallMonitor(_cfg())
+        mon.on_generation(1, [w])
+        assert w.built == 0            # model load builds nothing
+        r = w.retriever()              # first query builds → arm fires
+        assert w.built == 1
+        assert r.recall_hook is not None
+        # a pending arm for a swapped-out generation must no-op
+        w2 = LazyWrap()
+        mon.on_generation(2, [w2])
+        mon.on_generation(3, [])
+        assert w2.retriever().recall_hook is None
+        mon.close()
+
+    def test_kill_switch_registers_zero_instruments(self, pio_home,
+                                                    monkeypatch):
+        monkeypatch.setenv("PIO_RECALL", "off")
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        w = _Wrap(it, ivf, pq, None)
+        mon = RecallMonitor()
+        assert not mon.enabled
+        mon.on_generation(1, [w])
+        assert w._r.recall_hook is None      # hook never armed
+        assert mon.payload() == {"enabled": False}
+        doc = {"enabled": True, "verdict": "healthy",
+               "gate": {"enabled": True, "rollback": False,
+                        "reasons": []}}
+        assert mon.augment_quality(doc) is doc   # passes UNTOUCHED
+        mon.close()
+        reg = get_registry()
+        for name in RECALL_METRICS:
+            assert reg.get(name) is None, name
+
+    def test_augment_folds_gate_and_respects_gate_switch(
+            self, pio_home, monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        sc = _scorecard(0.95, ("ivf_pq",))
+        sc.fingerprint = None
+        w = _Wrap(it, ivf, pq, sc)
+        mon = RecallMonitor(_cfg(min_samples=5))
+        mon.on_generation(3, [w])
+        # the real structures recall ~0.6 against a 0.95 baseline: rot
+        _drive(w._r, qv, 30, monkeypatch=monkeypatch)
+        while mon.drain_once():
+            pass
+        quality = {"enabled": True, "verdict": "healthy",
+                   "gate": {"enabled": True, "rollback": False,
+                            "reasons": []}}
+        out = mon.augment_quality(dict(quality))
+        assert out["recall"]["tripped"]
+        assert out["gate"]["rollback"]
+        assert "recall_regression" in out["gate"]["reasons"]
+        assert out["verdict"] == "degraded"
+        mon.close()
+        # PIO_RECALL_GATE=off: reports, never gates
+        mon2 = RecallMonitor(_cfg(min_samples=5, gate=False))
+        mon2.on_generation(3, [w])
+        _drive(w._r, qv, 30, monkeypatch=monkeypatch)
+        while mon2.drain_once():
+            pass
+        out2 = mon2.augment_quality(dict(quality))
+        assert out2["recall"]["tripped"]
+        assert not out2["gate"]["rollback"]
+        assert out2["verdict"] == "healthy"
+        mon2.close()
+
+    def test_fingerprint_mismatch_is_reporting_only_never_gates(
+            self, pio_home, monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        sc = _scorecard(0.99, ("ivf_pq",))
+        sc.fingerprint = "not-the-corpus"
+        w = _Wrap(it, ivf, pq, sc)
+        mon = RecallMonitor(_cfg(min_samples=5))
+        mon.on_generation(1, [w])
+        _drive(w._r, qv, 30, monkeypatch=monkeypatch)
+        while mon.drain_once():
+            pass
+        doc = mon.payload()
+        assert doc["reportingOnly"]
+        assert doc["reason"] == "fingerprint_mismatch"
+        assert doc["verdict"] == "reporting_only"
+        assert not doc["tripped"]
+        out = mon.augment_quality({"enabled": True, "verdict": "healthy",
+                                   "gate": {"enabled": True,
+                                            "rollback": False,
+                                            "reasons": []}})
+        assert not out["gate"]["rollback"]
+        mon.close()
+
+    def test_quality_layer_off_still_publishes_a_gate(self, pio_home,
+                                                      monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        w = _Wrap(it, ivf, pq, None)
+        mon = RecallMonitor(_cfg())
+        mon.on_generation(2, [w])
+        out = mon.augment_quality({"enabled": False})
+        assert out["enabled"] and not out["qualityLayerEnabled"]
+        assert out["gate"]["rollback"] is False
+        assert out["recall"]["enabled"]
+        mon.close()
+
+
+# ==========================================================================
+# Fleet merge: schema stability + worst-instance semantics
+# ==========================================================================
+
+def _doc_keys(doc, prefix=""):
+    out = set()
+    for k, v in doc.items():
+        out.add(prefix + k)
+        if isinstance(v, dict):
+            out |= _doc_keys(v, prefix + k + ".")
+    return out
+
+
+def _recall_doc(fast, slow, baseline, captured=10, tripped=False):
+    return {
+        "enabled": True,
+        "verdict": "degraded" if tripped else "healthy",
+        "recall": {
+            "enabled": True, "tripped": tripped, "reportingOnly": False,
+            "captured": captured, "scored": captured, "dropped": 0,
+            "rungs": {"ivf_pq": {
+                "recallFast": fast, "recallSlow": slow,
+                "baseline": baseline, "nFast": captured,
+                "nSlow": captured, "tripped": tripped,
+                "shortlistSaturation": 0.1, "cellMiss": 0.2,
+                "scannedFraction": 0.05}},
+        },
+        "gate": {"enabled": True, "rollback": tripped,
+                 "reasons": ["recall_regression"] if tripped else []},
+    }
+
+
+class TestRecallFleetMerge:
+    def test_merge_never_silently_drops_recall_fields(self, pio_home):
+        d1 = _recall_doc(0.9, 0.92, 0.95)
+        d2 = _recall_doc(0.6, 0.65, 0.95, tripped=True)
+        merged = merge_quality([d1, d2])
+        missing = (_doc_keys(d1) | _doc_keys(d2)) - _doc_keys(merged)
+        assert not missing, f"fleet merge dropped fields: {missing}"
+
+    def test_worst_instance_semantics(self, pio_home):
+        d1 = _recall_doc(0.9, 0.92, 0.95, captured=10)
+        d2 = _recall_doc(0.6, 0.65, 0.93, captured=7, tripped=True)
+        merged = merge_quality([d1, d2])
+        row = merged["recall"]["rungs"]["ivf_pq"]
+        # recall takes the WORST instance (min), counts sum
+        assert row["recallFast"] == pytest.approx(0.6)
+        assert row["recallSlow"] == pytest.approx(0.65)
+        assert row["baseline"] == pytest.approx(0.93)
+        assert row["nFast"] == 17
+        assert merged["recall"]["captured"] == 17
+        # one rotten replica surfaces fleet-wide
+        assert merged["recall"]["tripped"]
+        assert merged["gate"]["rollback"]
+        assert "recall_regression" in merged["gate"]["reasons"]
+        assert merged["verdict"] == "degraded"
+
+    def test_union_of_keys_with_pre_recall_instance(self, pio_home):
+        # an older instance without the recall block: the key survives
+        old = {"enabled": True, "verdict": "healthy",
+               "gate": {"enabled": True, "rollback": False,
+                        "reasons": []}}
+        new = _recall_doc(0.9, 0.92, 0.95)
+        merged = merge_quality([old, new])
+        assert "recall" in merged
+        assert merged["recall"]["rungs"]["ivf_pq"]["recallFast"] \
+            == pytest.approx(0.9)
+
+    def test_live_monitor_payload_survives_merge(self, pio_home,
+                                                 monkeypatch):
+        it, qv = _corpus()
+        ivf, pq = _structures(it)
+        sc = build_recall_scorecard(qv, it, ivf=ivf, pq=pq, seed=0)
+        w = _Wrap(it, ivf, pq, sc)
+        mon = RecallMonitor(_cfg(min_samples=5))
+        mon.on_generation(1, [w])
+        _drive(w._r, qv, 20, monkeypatch=monkeypatch)
+        while mon.drain_once():
+            pass
+        doc = mon.augment_quality({"enabled": True, "verdict": "healthy",
+                                   "gate": {"enabled": True,
+                                            "rollback": False,
+                                            "reasons": []}})
+        merged = merge_quality([doc, json.loads(json.dumps(doc))])
+        assert not (_doc_keys(doc) - _doc_keys(merged))
+        assert merged["recall"]["rungs"]["ivf_pq"]["recallFast"] \
+            == doc["recall"]["rungs"]["ivf_pq"]["recallFast"]
+        mon.close()
+
+    def test_lint_rule5_recall_metrics_only_in_recall_module(self):
+        import tools.lint_metrics as lint
+
+        bad = ("import x\n"
+               "reg.gauge('pio_retrieval_recall_rogue', 'h', ())\n")
+        v = lint.check_source(bad, "predictionio_tpu/server/foo.py", {})
+        assert any("rule 5" in s for s in v)
+        ok = lint.check_source(bad, "predictionio_tpu/obs/recall.py", {})
+        assert not any("rule 5" in s for s in ok)
+        # other pio_retrieval_* families are NOT captured by rule 5
+        fine = ("import x\n"
+                "reg.counter('pio_retrieval_requests_total', 'h', ())\n")
+        assert not any(
+            "rule 5" in s for s in lint.check_source(
+                fine, "predictionio_tpu/retrieval/__init__.py", {}))
+        # and the real tree passes wholesale
+        assert lint.check() == []
+
+
+# ==========================================================================
+# Live e2e: healthy server, shared draw, kill switch on the wire
+# ==========================================================================
+
+def _http(base, method, path, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = Request(base + path, data=data, method=method,
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=15) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class TestRecallE2E:
+    def test_healthy_server_online_recall_matches_baseline(
+            self, ctx, monkeypatch):
+        _ivf_env(monkeypatch)
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "ivf")
+        monkeypatch.setenv("PIO_RECALL_SAMPLE", "1.0")
+        monkeypatch.setenv("PIO_RECALL_MIN_SAMPLES", "10")
+        monkeypatch.setenv("PIO_RECALL_FAST_WINDOW", "48")
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        run_train(eng, variant, ctx)
+        from predictionio_tpu.server import EngineServer
+
+        srv = EngineServer(eng, variant, ctx.storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for k in range(40):
+                st, _ = _http(base, "POST", "/queries.json",
+                              {"user": f"u{k % 10}", "num": 3})
+                assert st == 200
+            # off-thread worker: wait for the queue to drain
+            doc = None
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                st, doc = _http(base, "GET", "/quality.json")
+                assert st == 200
+                rec = doc.get("recall") or {}
+                row = (rec.get("rungs") or {}).get("ivf")
+                if row and row["nFast"] >= 10 \
+                        and rec.get("captured") == rec.get("scored"):
+                    break
+                time.sleep(0.1)
+            rec = doc["recall"]
+            row = rec["rungs"]["ivf"]
+            assert row["baseline"] is not None
+            # online recall@10 within tolerance of the baked baseline
+            assert row["recallFast"] >= row["baseline"] \
+                - rec["tolerance"]
+            assert not rec["tripped"]
+            assert rec["verdict"] == "healthy"
+            assert not doc["gate"]["rollback"]
+            # the exposition carries the single-owner gauge family
+            st, _ = _http(base, "GET", "/quality.json")
+            reg = get_registry()
+            fam = reg.get("pio_retrieval_recall")
+            assert fam is not None
+        finally:
+            srv.stop()
+
+    def test_kill_switch_on_live_server(self, ctx, monkeypatch):
+        _ivf_env(monkeypatch)
+        monkeypatch.setenv("PIO_RETRIEVAL_RUNG", "ivf")
+        monkeypatch.setenv("PIO_RECALL", "off")
+        app_id = _mk_app(ctx)
+        _seed_views(ctx, app_id)
+        eng, variant = _tt()
+        run_train(eng, variant, ctx)
+        from predictionio_tpu.server import EngineServer
+
+        srv = EngineServer(eng, variant, ctx.storage, host="127.0.0.1",
+                           port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for k in range(10):
+                st, _ = _http(base, "POST", "/queries.json",
+                              {"user": f"u{k % 10}", "num": 3})
+                assert st == 200
+            st, doc = _http(base, "GET", "/quality.json")
+            assert st == 200
+            # no recall block, no gate contribution, zero instruments
+            assert "recall" not in doc
+            assert not doc["gate"]["rollback"]
+            reg = get_registry()
+            for name in RECALL_METRICS:
+                assert reg.get(name) is None, name
+        finally:
+            srv.stop()

@@ -14,10 +14,13 @@ Usage::
     # against a saved /quality.json document
     python tools/attribute_quality.py quality.json
 
-Verdict order (worst wins): shadow divergence → drift tripped →
-reporting-only scorecard → falling online hit-rate → diversity collapse
-→ insufficient samples (cold app: pass-through, NEVER a gate) →
-healthy.
+Verdict order (worst wins): shadow divergence → recall regression
+(ISSUE 16 — with the specific knob named from the miss-attribution
+gauges: cell-miss dominant → widen ``PIO_IVF_NPROBE``,
+shortlist-saturation dominant → raise ``PIO_PQ_RERANK``, neither →
+rebuild the index) → drift tripped → reporting-only scorecard → falling
+online hit-rate → diversity collapse → insufficient samples (cold app:
+pass-through, NEVER a gate) → healthy.
 """
 
 from __future__ import annotations
@@ -76,6 +79,17 @@ def verdict_lines(doc: Dict[str, Any]) -> List[str]:
                f"{shadow.get('scored', 0)} pairs"
                + (" (no active canary)" if not shadow.get("active")
                   else ""))
+    recall = doc.get("recall") or {}
+    r_rungs = recall.get("rungs") or {}
+    if recall.get("enabled") and r_rungs:
+        rows = ", ".join(
+            f"{rung}: {_fmt(row.get('recallFast'))}/"
+            f"{_fmt(row.get('baseline'))}"
+            + ("!" if row.get("tripped") else "")
+            for rung, row in sorted(r_rungs.items()))
+        out.append(f"  recall@{recall.get('k')}: {rows} "
+                   f"(live/baseline per rung; sample "
+                   f"{recall.get('sample')})")
     gens = feedback.get("generations") or {}
 
     def _gen_key(kv):
@@ -103,6 +117,40 @@ def verdict_lines(doc: Dict[str, Any]) -> List[str]:
                    "PIO_QUALITY_GATE=on); inspect the refresh window — a "
                    "warm-start over a skewed delta is the usual cause "
                    "(pio_refresh_runs_total{result}).")
+    elif recall.get("tripped") and not recall.get("reportingOnly"):
+        bad = [(rung, row) for rung, row in sorted(r_rungs.items())
+               if row.get("tripped")]
+        rungs_s = ", ".join(
+            f"{rung} {_fmt(row.get('recallFast'))} vs baseline "
+            f"{_fmt(row.get('baseline'))}" for rung, row in bad)
+        out.append("DOMINANT: retrieval recall regression — the "
+                   "approximate rung(s) no longer return the true top-k "
+                   f"this generation's own scorecard promises ({rungs_s}"
+                   f", tolerance {recall.get('tolerance')}).")
+        # The miss-attribution gauges name the knob: a missed true item
+        # whose cell was probed fell off the PQ rerank shortlist; one
+        # whose cell was NOT probed never entered the race.
+        cell = max((row.get("cellMiss") or 0.0) for _, row in bad)
+        shortlist = max((row.get("shortlistSaturation") or 0.0)
+                        for _, row in bad)
+        if cell > shortlist and cell > 0.05:
+            out.append(f"ATTACK: cell-miss dominant ({cell:.0%} of true "
+                       f"top-k in unprobed cells) — widen "
+                       f"PIO_IVF_NPROBE; the probe ring is too narrow "
+                       f"for this corpus.")
+        elif shortlist > cell and shortlist > 0.05:
+            out.append(f"ATTACK: shortlist saturation dominant "
+                       f"({shortlist:.0%} of true top-k probed but "
+                       f"outside the rerank shortlist) — raise "
+                       f"PIO_PQ_RERANK; quantization error is pushing "
+                       f"true items below the cut.")
+        else:
+            out.append("ATTACK: neither cell-miss nor shortlist "
+                       "saturation dominates — the index/codes "
+                       "themselves no longer fit the corpus (skewed "
+                       "delta-refresh is the usual cause); rebuild by "
+                       "retraining.  Inside a canary window the gate "
+                       "rolls back first.")
     elif drift.get("tripped"):
         out.append("DOMINANT: score-distribution drift — serving scores "
                    "no longer match the generation's own training-time "

@@ -29,6 +29,13 @@ This lint locks those invariants in (tier-1 test runs it in CI):
    schema from that one module, so a quality series minted elsewhere
    would fork the schema the merge (and the schema-stability test)
    relies on.
+5. (ISSUE 16) Retrieval-recall metric families — the
+   ``pio_retrieval_recall`` prefix — may be REGISTERED only in
+   ``obs/recall.py``, the same single-owner contract as rule 4: the
+   recall block of ``/quality.json`` (and its worst-instance fleet
+   merge) is derived from that one module.  Note the facade's other
+   ``pio_retrieval_*`` families stay where they are — the rule pins
+   the ``pio_retrieval_recall`` prefix specifically.
 
 Usage: ``python tools/lint_metrics.py [root]`` — prints violations and
 exits non-zero when any exist.
@@ -141,6 +148,13 @@ def check_source(source: str, filename: str,
                 f"{where}: quality metric {name!r} registered outside "
                 f"obs/quality.py — the /quality.json fleet-merge schema "
                 f"is owned by that one module (rule 4)")
+        if name.startswith("pio_retrieval_recall") \
+                and not filename.replace("\\", "/").endswith(
+                    "obs/recall.py"):
+            violations.append(
+                f"{where}: retrieval-recall metric {name!r} registered "
+                f"outside obs/recall.py — the recall fleet-merge schema "
+                f"is owned by that one module (rule 5)")
         labels = _literal_labelnames(labels_node)
         if labels is None:
             violations.append(

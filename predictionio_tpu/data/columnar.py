@@ -1,4 +1,5 @@
-"""Vectorized Arrow-native transforms for the P training path.
+"""Vectorized Arrow-native transforms for the P training path, plus the
+append-only columnar segment store (ISSUE 17).
 
 Reference: the reference's RDD path (SURVEY.md §2.1 "User-facing stores")
 keeps event data distributed/columnar from storage scan to trainer input.
@@ -15,12 +16,34 @@ north star.  These helpers keep everything in Arrow/numpy kernels:
   ``json.dumps`` (numbers appear as bare literals); not usable for
   string/nested values, which keep the slow path.
 - ``event_mask``: boolean numpy mask for event-name membership.
+
+Segment store (the second half of this module): the event server tees
+every landed write into per-(app, channel) append-only ``.seg`` files —
+CRC-per-block Arrow IPC payloads, sealed per watermark window via
+tmp+rename, merged by a crash-safe compactor — so the PR-10 warm-refresh
+delta read becomes a columnar slice whose cost scales with the WINDOW,
+not with total store size.  Segments are derived data: the primary event
+store stays the source of truth, a reader that cannot prove coverage of
+a time range falls back to it, and a crash can at worst shrink coverage
+(never corrupt a read — torn tails are truncated at writer open, bad-CRC
+blocks stop a reader cold).  The lint (tools/lint_ingest.py) bans raw
+``open()`` on ``.seg`` files outside this module so the crash discipline
+stays in one place.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import re
-from typing import Optional, Sequence, Tuple, Union
+import shutil
+import threading
+import time
+import uuid
+import zlib
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 import pyarrow as pa
@@ -28,8 +51,11 @@ import pyarrow.compute as pc
 
 from predictionio_tpu.data.event import BiMap
 
+logger = logging.getLogger(__name__)
+
 __all__ = ["encode_ids", "numeric_property", "bool_property", "event_mask",
-           "dict_take"]
+           "dict_take", "SegmentStore", "SegmentDiskPressure",
+           "filter_event_table", "resolve_segment_root", "SEGMENT_SUFFIX"]
 
 _ColumnLike = Union[pa.Array, pa.ChunkedArray]
 
@@ -193,3 +219,669 @@ def event_mask(
     return pc.is_in(
         arr, value_set=pa.array(list(names))
     ).to_numpy(zero_copy_only=False)
+
+
+# ===========================================================================
+# Columnar segment store (ISSUE 17 tentpole)
+# ===========================================================================
+#
+# On-disk layout (single writer per root — the event server; readers are
+# lock-free and cross-process safe):
+#
+#     <root>/app_<id>/<default|ch_N>/
+#         manifest.json            # THE commit point (tmp+rename+dir fsync)
+#         seg-<seq>-<wStart>-<wEnd>.seg   # sealed, fsynced, immutable
+#         active-<wStart>-<rand>.tmp      # open window, never claimed
+#
+# Segment file = 6-byte magic + blocks of [u32 len][payload][u32 crc32],
+# payload = one Arrow IPC stream of EVENT_ARROW_SCHEMA rows.  Sealed files
+# are fsynced before the rename, so a bad CRC there is real corruption
+# (reader drops coverage, falls back to the primary store).  The active
+# file is deliberately NOT fsynced per block — segments are derived data —
+# so a crash can tear its tail; recovery truncates to the last valid
+# block (counted + WARNed, the PR-2 journal discipline) and then discards
+# the file: its window was never claimed, the primary store has the rows.
+#
+# Coverage is one interval per (app, channel): [floorUs, activeStartUs).
+# The claim: every event the primary store holds with event_time_us in
+# that interval is present in the sealed segments.  Seal picks the window
+# end ``now - grace`` so rows still in flight between primary commit and
+# segment tee can't be claimed before they land; a genuinely LATE event
+# (client-stamped event_time older than the open window) would silently
+# break the claim, so it ratchets ``floor`` up to the window start —
+# coverage shrinks, reads fall back, correctness holds.  Reads overlap
+# segments by their actual data range (minUs/maxUs), not their window
+# label, so straggler rows teed into the next window are still found.
+# ===========================================================================
+
+from predictionio_tpu.resilience.faults import fault_point
+
+SEGMENT_SUFFIX = ".seg"
+_SEG_MAGIC = b"PSEG1\n"
+_U32 = 4
+
+
+class SegmentDiskPressure(RuntimeError):
+    """Free disk below PIO_DISK_MIN_FREE_BYTES — the segment writer backs
+    off BEFORE ENOSPC can tear a write; ingest itself continues (segments
+    are derived data) with /ready reporting the degradation."""
+
+
+def resolve_segment_root(explicit: Optional[str] = None) -> Optional[Path]:
+    """Segment root: explicit arg > $PIO_SEGMENT_DIR > $PIO_HOME/segments.
+    ``PIO_SEGMENTS=off`` disables segments entirely (returns None)."""
+    if os.environ.get("PIO_SEGMENTS", "").lower() in ("off", "0", "false"):
+        return None
+    if explicit:
+        return Path(explicit)
+    env = os.environ.get("PIO_SEGMENT_DIR")
+    if env:
+        return Path(env)
+    home = os.environ.get("PIO_HOME")
+    if home:
+        return Path(home) / "segments"
+    return None
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("bad %s=%r; using %s", name, raw, default)
+        return default
+
+
+def _now_us(clock) -> int:
+    return int(clock() * 1e6)
+
+
+def recover_segment_tail(path: Path, truncate: bool = True) -> Dict[str, Any]:
+    """Torn-tail recovery for one segment file — the PR-2 journal
+    discipline: scan ``[len][payload][crc]`` blocks, stop at the first
+    short read or CRC mismatch, truncate the file to the last valid
+    block, and report what happened.
+
+    Returns ``{"rows", "blocks", "valid_bytes", "torn_bytes",
+    "payloads"}`` (payloads as raw bytes, CRC-verified).  Never raises on
+    damage — damage is the expected input.
+    """
+    payloads: List[bytes] = []
+    rows = 0
+    size = path.stat().st_size
+    with open(path, "r+b" if truncate else "rb") as f:
+        magic = f.read(len(_SEG_MAGIC))
+        if magic != _SEG_MAGIC:
+            valid = 0
+        else:
+            valid = len(_SEG_MAGIC)
+            while True:
+                head = f.read(_U32)
+                if len(head) < _U32:
+                    break
+                ln = int.from_bytes(head, "little")
+                body = f.read(ln + _U32)
+                if len(body) < ln + _U32:
+                    break
+                payload, crc = body[:ln], body[ln:]
+                if zlib.crc32(payload) != int.from_bytes(crc, "little"):
+                    break
+                payloads.append(payload)
+                valid += _U32 + ln + _U32
+        torn = size - valid
+        if torn and truncate:
+            f.truncate(valid)
+    for p in payloads:
+        with pa.ipc.open_stream(p) as rd:
+            rows += rd.read_all().num_rows
+    if torn:
+        logger.warning(
+            "segment %s: torn tail — truncated %d byte(s) to last valid "
+            "block (%d block(s), %d row(s) kept)",
+            path, torn, len(payloads), rows)
+        _seg_counter("pio_segment_torn_bytes_total", torn)
+    return {"rows": rows, "blocks": len(payloads), "valid_bytes": valid,
+            "torn_bytes": torn, "payloads": payloads}
+
+
+def _payloads_to_table(payloads: Sequence[bytes]) -> pa.Table:
+    from predictionio_tpu.data.storage.base import EVENT_ARROW_SCHEMA
+
+    tables = []
+    for p in payloads:
+        with pa.ipc.open_stream(p) as rd:
+            tables.append(rd.read_all())
+    if not tables:
+        return pa.table(
+            {f.name: pa.nulls(0, f.type) for f in EVENT_ARROW_SCHEMA},
+            schema=EVENT_ARROW_SCHEMA)
+    return pa.concat_tables(tables, promote_options="permissive")
+
+
+def _table_to_payload(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as wr:
+        wr.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _seg_counter(name: str, by: int = 1) -> None:
+    try:
+        from predictionio_tpu.obs import get_registry
+
+        reg = get_registry()
+        # get-or-create with literal names (metrics-lint schema check)
+        counters = {
+            "pio_segment_torn_bytes_total": reg.counter(
+                "pio_segment_torn_bytes_total",
+                "Bytes truncated from torn segment tails on recovery."),
+            "pio_segment_active_discarded_total": reg.counter(
+                "pio_segment_active_discarded_total",
+                "Crashed unsealed windows discarded on reopen."),
+            "pio_segment_late_events_total": reg.counter(
+                "pio_segment_late_events_total",
+                "Events below the open window start (floor ratcheted)."),
+            "pio_segment_seals_total": reg.counter(
+                "pio_segment_seals_total",
+                "Segment windows sealed (manifest commits)."),
+            "pio_segment_compactions_total": reg.counter(
+                "pio_segment_compactions_total",
+                "Small-segment compaction runs committed."),
+        }
+        counters[name].inc(by)
+    except Exception:  # metrics must never break the data plane
+        pass
+
+
+def filter_event_table(
+    table: pa.Table,
+    start_us: Optional[int] = None,
+    until_us: Optional[int] = None,
+    entity_type: Optional[str] = None,
+    entity_id: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Optional[str] = None,
+    target_entity_id: Optional[str] = None,
+) -> pa.Table:
+    """Apply the ``find_columnar`` filter set to an in-memory event table
+    (segment reads return raw window slices; this brings them to parity
+    with what a storage backend's filtered scan would have returned)."""
+    if table.num_rows == 0:
+        return table
+    mask = np.ones(table.num_rows, dtype=bool)
+    if start_us is not None or until_us is not None:
+        ts = _as_array(table.column("event_time_us")).to_numpy(
+            zero_copy_only=False)
+        if start_us is not None:
+            mask &= ts >= start_us
+        if until_us is not None:
+            mask &= ts < until_us
+    for col, want in (("entity_type", entity_type),
+                      ("entity_id", entity_id),
+                      ("target_entity_type", target_entity_type),
+                      ("target_entity_id", target_entity_id)):
+        if want is not None:
+            mask &= pc.equal(
+                pc.fill_null(_as_array(table.column(col)), ""), want
+            ).to_numpy(zero_copy_only=False)
+    if event_names:
+        mask &= event_mask(table, event_names)
+    if bool(mask.all()):
+        return table
+    return table.filter(pa.array(mask))
+
+
+class _SegmentDir:
+    """Writer-side state for one (app, channel) segment directory."""
+
+    def __init__(self, path: Path, clock):
+        self.path = path
+        self.lock = threading.Lock()
+        self.clock = clock
+        self.active_file = None  # open file handle for the active window
+        self.active_path: Optional[Path] = None
+        self.active_rows = 0
+        self.active_bytes = 0
+        self.active_min_us: Optional[int] = None
+        self.active_max_us: Optional[int] = None
+        self.active_opened_s = 0.0
+        self.manifest = self._load_and_recover()
+
+    # -- manifest -----------------------------------------------------------
+
+    def _load_and_recover(self) -> Dict[str, Any]:
+        self.path.mkdir(parents=True, exist_ok=True)
+        mpath = self.path / "manifest.json"
+        if mpath.exists():
+            manifest = json.loads(mpath.read_text())
+        else:
+            now = _now_us(self.clock)
+            manifest = {"version": 1, "floorUs": now, "nextSeq": 0,
+                        "activeStartUs": now, "segments": []}
+        # Crash recovery (single writer): anything on disk the manifest
+        # does not reference is garbage from an interrupted seal/compact —
+        # EXCEPT a leftover active file, which gets the torn-tail
+        # treatment first so the damage is measured and logged, then is
+        # discarded: its window was never claimed, the primary store is
+        # authoritative for those rows, and keeping it would let a future
+        # seal claim a window with rows lost from the in-flight tee.
+        referenced = {e["file"] for e in manifest["segments"]}
+        for p in sorted(self.path.iterdir()):
+            if p.name == "manifest.json" or p.name in referenced:
+                continue
+            if p.name.startswith("active-") and p.suffix == ".tmp":
+                try:
+                    stats = recover_segment_tail(p)
+                    logger.warning(
+                        "segment dir %s: discarding crashed active window "
+                        "(%d recoverable row(s); primary store is "
+                        "authoritative, window was never claimed)",
+                        self.path, stats["rows"])
+                    _seg_counter("pio_segment_active_discarded_total")
+                except OSError:
+                    pass
+            elif p.suffix not in (SEGMENT_SUFFIX, ".tmp"):
+                continue  # not ours — leave unknown files alone
+            else:
+                logger.warning("segment dir %s: sweeping orphan %s "
+                               "(interrupted seal/compaction)",
+                               self.path, p.name)
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        return manifest
+
+    def write_manifest(self) -> None:
+        fault_point("segment.manifest")
+        tmp = self.path / "manifest.tmp"
+        data = json.dumps(self.manifest, indent=0).encode()
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, self.path / "manifest.json")
+        self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    # -- active window ------------------------------------------------------
+
+    def append_table(self, table: pa.Table) -> None:
+        """One CRC block per tee (no cross-call buffering: a crash may
+        tear only the LAST write, never lose earlier acknowledged ones)."""
+        if table.num_rows == 0:
+            return
+        fault_point("segment.append")
+        ts = _as_array(table.column("event_time_us")).to_numpy(
+            zero_copy_only=False)
+        tmin, tmax = int(ts.min()), int(ts.max())
+        if tmin < self.manifest["activeStartUs"]:
+            # A late event: older than the open window, i.e. inside (or
+            # below) ranges sealed segments claim complete coverage of.
+            # Keeping the claim would be a silent lie — ratchet the floor
+            # to the window start instead; reads below it fall back to
+            # the primary store, which has the row.
+            if self.manifest["floorUs"] < self.manifest["activeStartUs"]:
+                logger.warning(
+                    "segment dir %s: late event (event_time %dus < window "
+                    "start %dus) — raising coverage floor; delta reads "
+                    "below it fall back to the primary store",
+                    self.path, tmin, self.manifest["activeStartUs"])
+                self.manifest["floorUs"] = self.manifest["activeStartUs"]
+                self.write_manifest()
+            _seg_counter("pio_segment_late_events_total", table.num_rows)
+        if self.active_file is None:
+            start = self.manifest["activeStartUs"]
+            self.active_path = self.path / (
+                f"active-{start}-{uuid.uuid4().hex[:8]}.tmp")
+            self.active_file = open(self.active_path, "wb")
+            self.active_file.write(_SEG_MAGIC)
+            self.active_bytes = len(_SEG_MAGIC)
+            self.active_opened_s = self.clock()
+        payload = _table_to_payload(table)
+        block = (len(payload).to_bytes(_U32, "little") + payload
+                 + zlib.crc32(payload).to_bytes(_U32, "little"))
+        self.active_file.write(block)
+        self.active_bytes += len(block)
+        self.active_rows += table.num_rows
+        self.active_min_us = (tmin if self.active_min_us is None
+                              else min(self.active_min_us, tmin))
+        self.active_max_us = (tmax if self.active_max_us is None
+                              else max(self.active_max_us, tmax))
+
+    def seal(self, grace_us: int) -> Optional[Dict[str, Any]]:
+        """Seal the active window: fsync, rename to its final ``.seg``
+        name, commit to the manifest.  Window end is ``now - grace`` so
+        rows still in flight between primary commit and segment tee
+        cannot fall inside a claimed range."""
+        if self.active_file is None or self.active_rows == 0:
+            if self.active_file is not None:
+                self.active_file.close()
+                try:
+                    self.active_path.unlink()
+                except OSError:
+                    pass
+                self.active_file = None
+                self.active_path = None
+            return None
+        fault_point("segment.seal")
+        self.active_file.flush()
+        os.fsync(self.active_file.fileno())
+        self.active_file.close()
+        w_start = self.manifest["activeStartUs"]
+        w_end = max(w_start + 1, _now_us(self.clock) - grace_us)
+        seq = self.manifest["nextSeq"]
+        final = self.path / f"seg-{seq:08d}-{w_start}-{w_end}{SEGMENT_SUFFIX}"
+        os.rename(self.active_path, final)
+        entry = {"file": final.name, "wStartUs": w_start, "wEndUs": w_end,
+                 "minUs": self.active_min_us, "maxUs": self.active_max_us,
+                 "rows": self.active_rows, "bytes": self.active_bytes}
+        self.manifest["segments"].append(entry)
+        self.manifest["nextSeq"] = seq + 1
+        self.manifest["activeStartUs"] = w_end
+        self.write_manifest()  # fsyncs the dir → covers the rename too
+        self.active_file = None
+        self.active_path = None
+        self.active_rows = 0
+        self.active_bytes = 0
+        self.active_min_us = None
+        self.active_max_us = None
+        _seg_counter("pio_segment_seals_total")
+        return entry
+
+    # -- compaction ---------------------------------------------------------
+
+    def compact(self, small_bytes: int) -> Dict[str, int]:
+        """Merge maximal runs of adjacent small sealed segments.
+
+        Crash-safe by construction: the merged file is written aside and
+        fsynced, then the manifest rename commits the swap, then the old
+        files are unlinked.  A kill at ANY point leaves either the old
+        set (manifest not yet renamed — the merged tmp is swept at next
+        open) or the new set (manifest renamed — leftover old files are
+        swept at next open) fully readable.  Never both (the manifest
+        references exactly one set), never neither.
+        """
+        segs = self.manifest["segments"]
+        runs: List[Tuple[int, int]] = []
+        i = 0
+        while i < len(segs):
+            j = i
+            while j < len(segs) and segs[j]["bytes"] < small_bytes:
+                j += 1
+            if j - i >= 2:
+                runs.append((i, j))
+            i = max(j, i + 1)
+        stats = {"runs": 0, "segments_in": 0, "segments_out": 0}
+        for start, end in reversed(runs):  # right-to-left: indices stable
+            run = segs[start:end]
+            fault_point("segment.compact")
+            tables = []
+            for e in run:
+                rec = recover_segment_tail(self.path / e["file"],
+                                           truncate=False)
+                if rec["torn_bytes"] or rec["rows"] != e["rows"]:
+                    logger.error(
+                        "segment %s: sealed file damaged (%d torn bytes, "
+                        "%d/%d rows) — skipping compaction of this run",
+                        e["file"], rec["torn_bytes"], rec["rows"], e["rows"])
+                    tables = None
+                    break
+                tables.append(_payloads_to_table(rec["payloads"]))
+            if tables is None:
+                continue
+            merged = pa.concat_tables(tables, promote_options="permissive")
+            payload = _table_to_payload(merged)
+            block = (len(payload).to_bytes(_U32, "little") + payload
+                     + zlib.crc32(payload).to_bytes(_U32, "little"))
+            seq = self.manifest["nextSeq"]
+            w_start, w_end = run[0]["wStartUs"], run[-1]["wEndUs"]
+            final = self.path / (
+                f"seg-{seq:08d}-{w_start}-{w_end}{SEGMENT_SUFFIX}")
+            tmp = self.path / f"compact-{uuid.uuid4().hex[:8]}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(_SEG_MAGIC + block)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, final)
+            fault_point("segment.compact.commit")
+            entry = {"file": final.name, "wStartUs": w_start,
+                     "wEndUs": w_end,
+                     "minUs": min(e["minUs"] for e in run),
+                     "maxUs": max(e["maxUs"] for e in run),
+                     "rows": merged.num_rows,
+                     "bytes": len(_SEG_MAGIC) + len(block)}
+            self.manifest["segments"][start:end] = [entry]
+            self.manifest["nextSeq"] = seq + 1
+            self.write_manifest()  # ← the commit point
+            fault_point("segment.compact.cleanup")
+            for e in run:
+                try:
+                    (self.path / e["file"]).unlink()
+                except OSError:
+                    pass
+            stats["runs"] += 1
+            stats["segments_in"] += len(run)
+            stats["segments_out"] += 1
+            _seg_counter("pio_segment_compactions_total")
+        return stats
+
+
+class SegmentStore:
+    """Per-(app, channel) append-only columnar segment files.
+
+    Single WRITER per root (the event server tees landed writes through
+    :meth:`append_events`); any number of cross-process READERS go
+    through :meth:`read_window`, which consults only ``manifest.json``
+    and sealed files.  See the module banner for the crash model.
+    """
+
+    def __init__(self, root, *, roll_bytes: Optional[int] = None,
+                 roll_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 min_free_bytes: Optional[int] = None,
+                 compact_small_bytes: Optional[int] = None,
+                 compact_trigger: Optional[int] = None,
+                 clock=time.time):
+        self.root = Path(root)
+        self.roll_bytes = int(roll_bytes if roll_bytes is not None
+                              else _env_f("PIO_SEGMENT_ROLL_BYTES", 4 << 20))
+        self.roll_s = float(roll_s if roll_s is not None
+                            else _env_f("PIO_SEGMENT_ROLL_S", 60.0))
+        self.grace_us = int(1e6 * (grace_s if grace_s is not None
+                                   else _env_f("PIO_SEGMENT_GRACE_S", 5.0)))
+        self.min_free_bytes = int(
+            min_free_bytes if min_free_bytes is not None
+            else _env_f("PIO_DISK_MIN_FREE_BYTES", 0))
+        self.compact_small_bytes = int(
+            compact_small_bytes if compact_small_bytes is not None
+            else _env_f("PIO_SEGMENT_COMPACT_BYTES", 1 << 20))
+        self.compact_trigger = int(
+            compact_trigger if compact_trigger is not None
+            else _env_f("PIO_SEGMENT_COMPACT_TRIGGER", 16))
+        self.clock = clock
+        self._dirs: Dict[Tuple[int, Optional[int]], _SegmentDir] = {}
+        self._dirs_lock = threading.Lock()
+        self._disk_checked_s = 0.0
+        self._disk_free = None
+
+    @classmethod
+    def open_default(cls, **kwargs) -> Optional["SegmentStore"]:
+        root = resolve_segment_root()
+        return cls(root, **kwargs) if root is not None else None
+
+    # -- plumbing -----------------------------------------------------------
+
+    @staticmethod
+    def _dir_name(app_id: int, channel_id: Optional[int]) -> str:
+        ch = "default" if channel_id is None else f"ch_{channel_id}"
+        return f"app_{app_id}/{ch}"
+
+    def _dir(self, app_id: int, channel_id: Optional[int]) -> _SegmentDir:
+        key = (app_id, channel_id)
+        with self._dirs_lock:
+            d = self._dirs.get(key)
+            if d is None:
+                d = _SegmentDir(self.root / self._dir_name(app_id,
+                                                           channel_id),
+                                self.clock)
+                self._dirs[key] = d
+            return d
+
+    def disk_pressure(self) -> bool:
+        """True when free space under the root is below the configured
+        floor (~1s cached — this runs on every tee)."""
+        if self.min_free_bytes <= 0:
+            return False
+        now = self.clock()
+        if now - self._disk_checked_s > 1.0 or self._disk_free is None:
+            self._disk_checked_s = now
+            try:
+                self.root.mkdir(parents=True, exist_ok=True)
+                self._disk_free = shutil.disk_usage(self.root).free
+            except OSError:
+                self._disk_free = 0
+        return self._disk_free < self.min_free_bytes
+
+    # -- write path ---------------------------------------------------------
+
+    def append_events(self, app_id: int, channel_id: Optional[int],
+                      events) -> None:
+        """Tee one landed batch into the active window (raises
+        :class:`SegmentDiskPressure` instead of risking a torn ENOSPC
+        write; any other failure is the caller's to contain — ingest
+        must never fail because a derived file could not be written)."""
+        from predictionio_tpu.data.storage.base import events_to_arrow
+
+        self.append_table(app_id, channel_id, events_to_arrow(events))
+
+    def append_table(self, app_id: int, channel_id: Optional[int],
+                     table: pa.Table) -> None:
+        if table.num_rows == 0:
+            return
+        if self.disk_pressure():
+            raise SegmentDiskPressure(
+                f"free disk under {self.root} below "
+                f"PIO_DISK_MIN_FREE_BYTES={self.min_free_bytes}")
+        d = self._dir(app_id, channel_id)
+        with d.lock:
+            d.append_table(table)
+            if (d.active_bytes >= self.roll_bytes
+                    or self.clock() - d.active_opened_s >= self.roll_s):
+                d.seal(self.grace_us)
+                self._maybe_compact(d)
+
+    def seal_all(self) -> int:
+        """Seal every open window (server drain/stop, bench barriers)."""
+        sealed = 0
+        with self._dirs_lock:
+            dirs = list(self._dirs.values())
+        for d in dirs:
+            with d.lock:
+                if d.seal(self.grace_us) is not None:
+                    sealed += 1
+                    self._maybe_compact(d)
+        return sealed
+
+    def _maybe_compact(self, d: _SegmentDir) -> None:
+        if self.compact_trigger <= 0:
+            return
+        small = sum(1 for e in d.manifest["segments"]
+                    if e["bytes"] < self.compact_small_bytes)
+        if small >= self.compact_trigger:
+            d.compact(self.compact_small_bytes)
+
+    def compact(self, app_id: int,
+                channel_id: Optional[int] = None) -> Dict[str, int]:
+        d = self._dir(app_id, channel_id)
+        with d.lock:
+            return d.compact(self.compact_small_bytes)
+
+    # -- read path (cross-process safe: manifest + sealed files only) -------
+
+    def read_window(
+        self, app_id: int, channel_id: Optional[int],
+        start_us: int, until_us: int, **filters
+    ) -> Optional[Tuple[pa.Table, int]]:
+        """Columnar slice of ``[start_us, min(until_us, covered))``.
+
+        Returns ``(table, covered_until_us)`` — the caller reads the
+        remaining ``[covered_until_us, until_us)`` tail from the primary
+        store — or None when segments cannot prove coverage from
+        ``start_us`` (reader falls back entirely; never guesses).
+        """
+        mpath = (self.root / self._dir_name(app_id, channel_id)
+                 / "manifest.json")
+        try:
+            manifest = json.loads(mpath.read_text())
+        except (OSError, ValueError):
+            return None
+        floor = manifest.get("floorUs", 0)
+        covered = manifest.get("activeStartUs", floor)
+        if start_us < floor:
+            return None  # claim does not reach back that far
+        covered_until = min(until_us, covered)
+        if covered_until <= start_us:
+            return None  # nothing useful covered — pure fallback
+        tables: List[pa.Table] = []
+        for e in manifest.get("segments", []):
+            lo = min(e["wStartUs"], e["minUs"])
+            hi = max(e["wEndUs"], e["maxUs"] + 1)
+            if hi <= start_us or lo >= covered_until:
+                continue
+            rec = recover_segment_tail(self.root
+                                       / self._dir_name(app_id, channel_id)
+                                       / e["file"], truncate=False)
+            if rec["torn_bytes"] or rec["rows"] != e["rows"]:
+                logger.error(
+                    "segment %s damaged (%d torn bytes, %d/%d rows) — "
+                    "dropping segment coverage, falling back to primary "
+                    "store", e["file"], rec["torn_bytes"], rec["rows"],
+                    e["rows"])
+                return None
+            tables.append(_payloads_to_table(rec["payloads"]))
+        if tables:
+            table = pa.concat_tables(tables, promote_options="permissive")
+        else:
+            table = _payloads_to_table(())
+        table = filter_event_table(table, start_us=start_us,
+                                   until_us=covered_until, **filters)
+        return table, covered_until
+
+    # -- observability ------------------------------------------------------
+
+    def status(self) -> List[Dict[str, Any]]:
+        """One row per (app, channel) dir on disk — for /ready and
+        ``pio status`` (reads manifests; safe cross-process)."""
+        out: List[Dict[str, Any]] = []
+        if not self.root.exists():
+            return out
+        for mpath in sorted(self.root.glob("app_*/*/manifest.json")):
+            try:
+                manifest = json.loads(mpath.read_text())
+            except (OSError, ValueError):
+                continue
+            segs = manifest.get("segments", [])
+            out.append({
+                "dir": str(mpath.parent.relative_to(self.root)),
+                "segments": len(segs),
+                "rows": sum(e["rows"] for e in segs),
+                "bytes": sum(e["bytes"] for e in segs),
+                "floorUs": manifest.get("floorUs", 0),
+                "coveredUntilUs": manifest.get("activeStartUs", 0),
+            })
+        return out
+
+    def close(self) -> None:
+        self.seal_all()

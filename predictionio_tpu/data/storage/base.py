@@ -18,6 +18,7 @@ from __future__ import annotations
 import abc
 import datetime as _dt
 import secrets
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
 
@@ -48,7 +49,17 @@ __all__ = [
     "StorageUnavailable",
     "normalize_event_table",
     "stamp_event_ids",
+    "batch_event_id",
 ]
+
+
+def batch_event_id(token: str) -> str:
+    """Deterministic event id for a bulk-ingest item from its idempotency
+    sub-token.  The id IS the dedup key: every backend's ``create_batch``
+    keys its conflict-ignoring insert on it, so a replayed batch (same
+    tokens) lands each row at most once — even when a crash left the
+    first attempt partially committed."""
+    return f"bt{token}"
 
 
 class StorageError(RuntimeError):
@@ -436,6 +447,36 @@ class Events(abc.ABC):
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> List[str]:
         return [self.insert(e, app_id, channel_id) for e in events]
+
+    def create_batch(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int] = None,
+        tokens: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        """One multi-row write with PER-ITEM exactly-once semantics
+        (ISSUE 17: the bulk-ingest data plane's storage contract).
+
+        ``tokens`` are the batch's per-item idempotency sub-tokens; each
+        item's event id is derived deterministically from its sub-token
+        (:func:`batch_event_id`), so a replay of the same batch after a
+        crashed reply — possibly after a PARTIAL landing — skips the rows
+        that already committed and re-inserts only the missing ones,
+        returning the same ids either way.  Without tokens a fresh set is
+        minted, which degrades to plain at-least-once ``insert_batch``
+        behavior.
+
+        The base default delegates to :meth:`insert_batch` (store-assigned
+        ids, at-least-once on replay — it cannot force ids on a backend it
+        knows nothing about); sqlite/memory/parquet override with a
+        genuinely single-round-trip conflict-ignoring write keyed on the
+        derived ids, and the pioserver backend forwards the call (token
+        set included) over one RPC.
+        """
+        if tokens is not None and len(tokens) != len(events):
+            raise StorageError(
+                f"create_batch: {len(events)} events but {len(tokens)} "
+                "tokens")
+        return self.insert_batch(events, app_id, channel_id)
 
     @abc.abstractmethod
     def get(

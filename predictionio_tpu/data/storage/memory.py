@@ -327,6 +327,36 @@ class MemoryEvents(base.Events):
             bucket[eid] = event.with_event_id(eid)
             return eid
 
+    def create_batch(
+        self,
+        events: Sequence[Event],
+        app_id: int,
+        channel_id: Optional[int] = None,
+        tokens: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        """One pass under the lock; ids derive from the sub-tokens, and a
+        key already present (prior partial landing of the same batch) is
+        left untouched — per-item exactly-once on replay."""
+        if tokens is None:
+            # One uuid4 per BATCH, not per event (see sqlite.create_batch).
+            pre = uuid.uuid4().hex
+            tokens = [f"{pre}{i:x}" for i in range(len(events))]
+        else:
+            tokens = list(tokens)
+        if len(tokens) != len(events):
+            raise base.StorageError(
+                f"create_batch: {len(events)} events but {len(tokens)} "
+                "tokens")
+        with self._lock:
+            bucket = self._bucket(app_id, channel_id)
+            ids = []
+            for ev, tok in zip(events, tokens):
+                eid = f"bt{tok}"  # base.batch_event_id, inlined
+                ids.append(eid)
+                if eid not in bucket:
+                    bucket[eid] = ev.with_event_id(eid)
+            return ids
+
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None):
         return self._bucket(app_id, channel_id).get(event_id)
 

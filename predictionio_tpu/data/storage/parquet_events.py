@@ -47,6 +47,12 @@ class ParquetEvents(base.Events):
         self.root = Path(root)
         self._lock = threading.RLock()
         self._pending: Dict[tuple, List[Event]] = {}
+        # Bulk-ingest dedup index (ISSUE 17): token-derived event ids
+        # already on disk, per (app, channel).  Seeded lazily with ONE
+        # projected scan of the event_id column, then maintained
+        # incrementally — parquet has no primary key to conflict on, so
+        # create_batch's per-item exactly-once lives here.
+        self._batch_ids: Dict[tuple, set] = {}
 
     def _dir(self, app_id: int, channel_id: Optional[int]) -> Path:
         chan = "default" if channel_id is None else str(channel_id)
@@ -61,6 +67,7 @@ class ParquetEvents(base.Events):
 
         with self._lock:
             self._pending.pop((app_id, channel_id), None)
+            self._batch_ids.pop((app_id, channel_id), None)
             d = self._dir(app_id, channel_id)
             if not d.exists():
                 return False
@@ -101,6 +108,51 @@ class ParquetEvents(base.Events):
         table = base.events_to_arrow(stamped)
         with self._lock:
             pq.write_table(table, d / f"part-{uuid.uuid4().hex}.parquet")
+        return ids
+
+    def _seen_batch_ids(self, d: Path, app_id: int,
+                        channel_id: Optional[int]) -> set:
+        """Token-derived ids already stored (caller holds the lock)."""
+        key = (app_id, channel_id)
+        seen = self._batch_ids.get(key)
+        if seen is None:
+            table = self._scan(d, app_id, channel_id, columns=["event_id"])
+            seen = set()
+            if table is not None:
+                for eid in table["event_id"].to_pylist():
+                    if eid and eid.startswith("bt"):
+                        seen.add(eid)
+            self._batch_ids[key] = seen
+        return seen
+
+    def create_batch(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: Optional[int] = None,
+        tokens: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        """One part file for the not-yet-landed rows; rows whose derived
+        id is already on disk (prior partial landing) are skipped, so a
+        replayed batch never duplicates."""
+        d = self._check_init(app_id, channel_id)
+        if tokens is None:
+            # One uuid4 per BATCH, not per event (see sqlite.create_batch).
+            pre = uuid.uuid4().hex
+            tokens = [f"{pre}{i:x}" for i in range(len(events))]
+        else:
+            tokens = list(tokens)
+        if len(tokens) != len(events):
+            raise base.StorageError(
+                f"create_batch: {len(events)} events but {len(tokens)} "
+                "tokens")
+        ids = [base.batch_event_id(t) for t in tokens]
+        with self._lock:
+            seen = self._seen_batch_ids(d, app_id, channel_id)
+            fresh = [ev.with_event_id(eid)
+                     for ev, eid in zip(events, ids) if eid not in seen]
+            if fresh:
+                pq.write_table(base.events_to_arrow(fresh),
+                               d / f"part-{uuid.uuid4().hex}.parquet")
+                seen.update(ev.event_id for ev in fresh)
         return ids
 
     def insert_columnar(
@@ -294,6 +346,11 @@ class ParquetEvents(base.Events):
                         pq.write_table(kept, p)
                     else:
                         p.unlink()
+                    # keep the bulk-ingest dedup index truthful: a deleted
+                    # token-derived row may legitimately be re-created
+                    seen = self._batch_ids.get((app_id, channel_id))
+                    if seen is not None:
+                        seen.discard(event_id)
                     return True
         return False
 

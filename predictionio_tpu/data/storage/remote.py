@@ -172,8 +172,8 @@ def _recv(sock: socket.socket, max_len: Optional[int] = None) -> Any:
 # callable over the wire (getattr dispatch would otherwise expose
 # private/backing methods).
 _ALLOWED = {
-    "events": {"init", "remove", "insert", "insert_batch", "get", "delete",
-               "find", "latest_event_time"},
+    "events": {"init", "remove", "insert", "insert_batch", "create_batch",
+               "get", "delete", "find", "latest_event_time"},
     "apps": {"insert", "get", "get_by_name", "get_all", "update", "delete"},
     "access_keys": {"insert", "get", "get_all", "get_by_app_id", "update",
                     "delete"},
@@ -702,6 +702,10 @@ class RemoteEvents(Events):
     remove = _forward("events", "remove")
     insert = _forward("events", "insert")
     insert_batch = _forward("events", "insert_batch")
+    # One RPC per batch; the per-item sub-tokens travel with the call, so
+    # the HOSTED backend's create_batch dedups per item even when the
+    # whole-call dedup window has already evicted the batch token.
+    create_batch = _forward("events", "create_batch")
     get = _forward("events", "get")
     delete = _forward("events", "delete")
     # One RPC to the backend's indexed MAX — the base-class default would

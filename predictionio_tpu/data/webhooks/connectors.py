@@ -11,7 +11,7 @@ connectors never write storage themselves.
 from __future__ import annotations
 
 import abc
-from typing import Any, Dict, Mapping, Type
+from typing import Any, Dict, List, Mapping, Type
 
 __all__ = ["ConnectorError", "JsonConnector", "FormConnector",
            "SegmentIOConnector", "MailchimpConnector", "register_connector",
@@ -28,12 +28,24 @@ class JsonConnector(abc.ABC):
     @abc.abstractmethod
     def to_event_json(self, payload: Mapping[str, Any]) -> Dict[str, Any]: ...
 
+    def to_events_json(self, payload: Mapping[str, Any]) -> List[Dict[str, Any]]:
+        """Burst entry (ISSUE 17): one provider delivery → N event JSONs,
+        fed through the server's batched-ingest fold as ONE group commit
+        instead of a per-row ``create_event`` loop.  Default wraps the
+        single-event mapping; connectors whose providers batch deliveries
+        (segment.io) override."""
+        return [self.to_event_json(payload)]
+
 
 class FormConnector(abc.ABC):
     """Payload is form-encoded key/value (reference: FormConnector)."""
 
     @abc.abstractmethod
     def to_event_json(self, form: Mapping[str, str]) -> Dict[str, Any]: ...
+
+    def to_events_json(self, form: Mapping[str, str]) -> List[Dict[str, Any]]:
+        """Burst entry — see :meth:`JsonConnector.to_events_json`."""
+        return [self.to_event_json(form)]
 
 
 class SegmentIOConnector(JsonConnector):
@@ -42,6 +54,23 @@ class SegmentIOConnector(JsonConnector):
     Segment spec fields: type, userId/anonymousId, event, properties/traits,
     timestamp.
     """
+
+    def to_events_json(self, payload: Mapping[str, Any]) -> List[Dict[str, Any]]:
+        """Segment's HTTP API delivers call batches as
+        ``{"batch": [msg, ...]}`` — coalesce the whole delivery into one
+        event list (one group commit downstream).  A malformed message
+        inside the batch stays a per-item error: it is passed through as
+        a ConnectorError placeholder for the fold to answer 400."""
+        if isinstance(payload, Mapping) and isinstance(
+                payload.get("batch"), list):
+            out: List[Any] = []
+            for msg in payload["batch"]:
+                try:
+                    out.append(self.to_event_json(msg))
+                except ConnectorError as e:
+                    out.append(e)
+            return out
+        return [self.to_event_json(payload)]
 
     def to_event_json(self, payload: Mapping[str, Any]) -> Dict[str, Any]:
         typ = payload.get("type")

@@ -215,6 +215,7 @@ def fault_point(name: str) -> None:
 _EVENTS_POINTS = {
     "insert": "storage.create",
     "insert_batch": "storage.create",
+    "create_batch": "storage.create",
     "insert_columnar": "storage.create",
     "find": "storage.find",
     "find_columnar": "storage.find",

@@ -124,14 +124,17 @@ class SharedSpillQueue:
     # -- journal-shaped surface ---------------------------------------------
 
     def append(self, events_json: List[Dict[str, Any]], app_id: int,
-               channel_id: Optional[int],
-               token: Optional[str] = None) -> str:
+               channel_id: Optional[int], token: Optional[str] = None,
+               tokens: Optional[List[str]] = None) -> str:
         """Durably enqueue one failed write under its idempotency token.
         Raises on storage failure — the caller (event server) degrades
-        to the local journal, the spill-of-the-spill."""
+        to the local journal, the spill-of-the-spill.  ``tokens`` are the
+        bulk endpoint's per-item sub-tokens (see SpillJournal.append)."""
         token = token or uuid.uuid4().hex
         record = {"token": token, "appId": app_id, "channelId": channel_id,
                   "events": list(events_json)}
+        if tokens is not None:
+            record["tokens"] = list(tokens)
         self._repo().enqueue(self.queue, record, token=token,
                              events=len(record["events"]),
                              now_s=self._clock())

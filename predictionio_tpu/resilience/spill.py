@@ -206,13 +206,20 @@ class SpillJournal:
             return self._pending_events
 
     def append(self, events_json: List[Dict[str, Any]], app_id: int,
-               channel_id: Optional[int],
-               token: Optional[str] = None) -> str:
+               channel_id: Optional[int], token: Optional[str] = None,
+               tokens: Optional[List[str]] = None) -> str:
         """Durably queue one failed write (1..n events) under the
-        idempotency token that write was issued with; returns the token."""
+        idempotency token that write was issued with; returns the token.
+
+        ``tokens`` (ISSUE 17) carries the bulk endpoint's PER-ITEM
+        sub-tokens: replay then lands through ``create_batch`` with ids
+        derived from them, so a batch that partially committed before
+        the crash dedups row-by-row instead of all-or-nothing."""
         token = token or uuid.uuid4().hex
         record = {"token": token, "appId": app_id, "channelId": channel_id,
                   "events": list(events_json)}
+        if tokens is not None:
+            record["tokens"] = list(tokens)
         line = json.dumps(record, separators=(",", ":"))
         with self._lock:
             # Remember the pre-write size and roll back to it if the

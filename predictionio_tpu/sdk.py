@@ -39,6 +39,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
 from predictionio_tpu.resilience.deadline import DEADLINE_HEADER
@@ -239,11 +240,62 @@ class EventClient:
         return EventResult(event_id or token or "", event_id=event_id,
                            token=token, status=status)
 
-    def create_events(self, events: Sequence[Mapping[str, Any]]) -> List[Dict]:
-        """Batch ingest (reference: /batch/events.json, ≤50 per call)."""
-        return self._request("POST",
-                             f"{self.base}/batch/events.json?{self._qs()}",
-                             list(events))
+    def create_events(self, events: Sequence[Mapping[str, Any]],
+                      batch_token: Optional[str] = None) -> List[EventResult]:
+        """Bulk ingest riding ``POST /batch/events.json`` (ISSUE 17).
+
+        One idempotency ``batch_token`` covers the whole batch
+        (auto-generated when not given): the server derives per-item
+        sub-tokens — and thus event ids — from it, so an opt-in retry
+        (``retries=N``) that re-sends the batch after a lost reply lands
+        every row AT MOST once.  Unlike single-event ``create_event``,
+        batch retries are exactly-once end-to-end.
+
+        Returns one typed :class:`EventResult` per item, in order:
+        ``.stored`` (201) with ``.event_id``, a 202 spill ``.token``, or
+        a per-item error (``.status`` 400/403 — one malformed item never
+        fails its cohort).  Old servers without the bulk endpoint (404)
+        degrade to a per-row ``create_event`` loop — at-least-once, like
+        any single-event retry.
+        """
+        items = [dict(e) for e in events]
+        token = batch_token or uuid.uuid4().hex
+        try:
+            out = self._request(
+                "POST",
+                f"{self.base}/batch/events.json?"
+                f"{self._qs({'batchToken': token})}",
+                items)
+        except PredictionIOError as e:
+            if e.status in (404, 405):  # pre-bulk server: row-loop
+                return [self._create_event_json(it) for it in items]
+            raise
+        results: List[EventResult] = []
+        for item in out or []:
+            eid = item.get("eventId")
+            tok = item.get("token")
+            results.append(EventResult(
+                eid or tok or "", event_id=eid, token=tok,
+                status=item.get("status")))
+        return results
+
+    def _create_event_json(self, body: Mapping[str, Any]) -> EventResult:
+        """Row-loop fallback: POST one already-shaped event JSON.  A
+        per-item failure becomes an errored EventResult (status carried
+        over) so the fallback keeps the bulk path's one-bad-row-never-
+        fails-the-cohort contract."""
+        try:
+            status, out = self._request(
+                "POST", f"{self.base}/events.json?{self._qs()}", dict(body),
+                want_status=True)
+        except PredictionIOError as e:
+            if e.status is None:
+                raise  # connection-level: the whole loop is doomed
+            return EventResult("", status=e.status)
+        out = out or {}
+        return EventResult(out.get("eventId") or out.get("token") or "",
+                           event_id=out.get("eventId"),
+                           token=out.get("token"), status=status)
 
     def get_event(self, event_id: str) -> Dict[str, Any]:
         return self._request(

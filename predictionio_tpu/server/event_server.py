@@ -27,12 +27,14 @@ import datetime as _dt
 import json
 import logging
 import os
+import re
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.data.columnar import SegmentDiskPressure, SegmentStore
 from predictionio_tpu.data.event import EventValidationError
 from predictionio_tpu.data.json_support import (
     event_from_json,
@@ -72,13 +74,33 @@ from predictionio_tpu.server.http import (
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["EventServer", "MAX_BATCH_SIZE"]
+__all__ = ["EventServer", "MAX_BATCH_SIZE", "max_batch_size"]
 
 MAX_BATCH_SIZE = 50  # reference: EventServer batch cap
+
+
+def max_batch_size() -> int:
+    """Batch cap for /batch/events.json — reference parity default (50),
+    raisable via PIO_MAX_BATCH_SIZE for bulk-load clients (the server's
+    group commit and segment tee are O(batch), so a larger cap costs
+    memory, not correctness)."""
+    raw = os.environ.get("PIO_MAX_BATCH_SIZE")
+    if not raw:
+        return MAX_BATCH_SIZE
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        logger.warning("bad PIO_MAX_BATCH_SIZE=%r; using %d", raw,
+                       MAX_BATCH_SIZE)
+        return MAX_BATCH_SIZE
 
 # Availability failures (vs client faults): these trip the breaker and
 # route to spill/503, never to a 400.
 _UNAVAILABLE = (CircuitOpenError, StorageUnavailable, ConnectionError)
+
+# Client-supplied batch idempotency tokens become event-id material —
+# keep them filesystem/URL-safe.
+_TOKEN_RE = re.compile(r"[A-Za-z0-9._-]+")
 
 
 class _EventMetrics:
@@ -183,6 +205,33 @@ class EventServer:
         # in memory — one MAX query per app per process, not per event).
         self._latest_ts: Dict[int, int] = {}
         self._latest_lock = threading.Lock()
+        # Columnar segment tee (ISSUE 17): landed writes are appended to
+        # per-(app, channel) segment files so warm-refresh delta reads
+        # become window-sized columnar slices.  Segments are DERIVED data:
+        # a tee failure degrades (counted, /ready-visible), never fails
+        # the ingest that already committed to the primary store.
+        try:
+            self.segments = SegmentStore.open_default()
+        except Exception:
+            logger.exception("segment store unavailable — tee disabled")
+            self.segments = None
+        self._segment_degraded = False
+        self._segment_errors = self.stats.registry.counter(
+            "pio_segment_tee_errors_total",
+            "Segment tee failures (ingest unaffected).", ("kind",))
+        # Write-path admission (ISSUE 17): one shared budget over events
+        # queued anywhere on the write plane (local journal + shared
+        # backplane + in-flight requests).  When the backlog exceeds it,
+        # new writes answer 429 + Retry-After instead of growing the
+        # spill without bound — bounded memory/disk beats a stalled
+        # /events.json.  0 disables (default).
+        self.ingest_budget = int(os.environ.get(
+            "PIO_INGEST_QUEUE_BUDGET", "0") or 0)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._overload = self.stats.registry.counter(
+            "pio_ingest_overload_total",
+            "Writes rejected 429 by the ingest admission budget.")
         self.spill: Optional[SpillJournal] = None
         self._replay: Optional[ReplayWorker] = None
         self.shared_spill: Optional[SharedSpillQueue] = None
@@ -243,8 +292,8 @@ class EventServer:
     # -- spill / replay -----------------------------------------------------
 
     def _spill_events(self, events_json: List[Any], app_id: int,
-                      channel_id: Optional[int],
-                      token: str) -> Optional[str]:
+                      channel_id: Optional[int], token: str,
+                      tokens: Optional[List[str]] = None) -> Optional[str]:
         """Durably queue one failed write (single event or whole batch)
         under the SAME idempotency token the write was issued with — if
         the "outage" was really a lost reply, the backend committed and
@@ -265,7 +314,8 @@ class EventServer:
         if self.shared_spill is not None and self._breaker.state != "open":
             try:
                 return self.shared_spill.append(events_json, app_id,
-                                                channel_id, token=token)
+                                                channel_id, token=token,
+                                                tokens=tokens)
             except Exception:
                 logger.warning("shared spill enqueue failed — degrading "
                                "to the local journal", exc_info=True)
@@ -273,7 +323,7 @@ class EventServer:
             return None
         try:
             return self.spill.append(events_json, app_id, channel_id,
-                                     token=token)
+                                     token=token, tokens=tokens)
         except (OSError, ValueError):  # ValueError: journal closed itself
             logger.exception("spill journal write failed")
             return None
@@ -282,15 +332,85 @@ class EventServer:
         """One journal record → storage, through the breaker (this worker
         is the half-open prober), re-issuing the ORIGINAL write: same
         token, same event set, so a dedup-capable backend answers from
-        its window if the original actually committed."""
+        its window if the original actually committed.
+
+        A record carrying per-item sub-``tokens`` (a bulk-ingest batch,
+        ISSUE 17) replays through ``create_batch``: event ids derive from
+        the sub-tokens, so a batch the crashed attempt PARTIALLY landed
+        dedups row-by-row — each event lands exactly once even when the
+        original commit split down the middle."""
         evs = [event_from_json(e) for e in record["events"]]
         events = self.storage.get_events()
+        tokens = record.get("tokens")
         with idempotency_key(record["token"]):
-            self._breaker.call(events.insert_batch, evs, record["appId"],
-                               record.get("channelId"))
+            if tokens is not None:
+                self._breaker.call(events.create_batch, evs,
+                                   record["appId"], record.get("channelId"),
+                                   tokens=tokens)
+            else:
+                self._breaker.call(events.insert_batch, evs,
+                                   record["appId"], record.get("channelId"))
         # Replayed events are now servable — advance the watermark they
         # could not advance while journaled.
         self._note_ingest(record["appId"], evs)
+        self._segment_tee(record["appId"], record.get("channelId"), evs)
+
+    # -- segment tee / write-plane admission (ISSUE 17) ---------------------
+
+    def _segment_tee(self, app_id: int, channel_id, evs) -> None:
+        """Append LANDED events to the columnar segment store.  Disk
+        pressure flips the degraded flag (and stops segment writes — the
+        journal-spill/primary path keeps ingesting); any other failure is
+        counted and swallowed: a derived file must never fail an ingest
+        that already committed."""
+        if self.segments is None or not evs:
+            return
+        try:
+            self.segments.append_events(app_id, channel_id, evs)
+            if self._segment_degraded:
+                logger.info("segment tee recovered (disk pressure cleared)")
+            self._segment_degraded = False
+        except SegmentDiskPressure as e:
+            if not self._segment_degraded:
+                logger.warning("segment tee degraded: %s — ingest "
+                               "continues without segment coverage", e)
+            self._segment_degraded = True
+            self._segment_errors.inc(kind="disk_pressure")
+        except Exception:
+            logger.exception("segment tee failed (ingest unaffected)")
+            self._segment_errors.inc(kind="error")
+
+    def _backlog_depth(self) -> int:
+        depth = self.spill.depth() if self.spill is not None else 0
+        if self.shared_spill is not None:
+            # cached: admission must never pay a storage RPC per request
+            depth += self.shared_spill.cached_depth()
+        return depth
+
+    def _admit(self, n: int) -> Optional[Tuple[int, Any]]:
+        """Reserve ``n`` events of write-plane budget, or answer the 429
+        (the transport adds Retry-After).  Pair with :meth:`_release` in
+        a finally.  Returns None on admission."""
+        fault_point("ingest.admit")
+        if self.ingest_budget <= 0:
+            with self._inflight_lock:
+                self._inflight += n
+            return None
+        depth = self._backlog_depth()
+        with self._inflight_lock:
+            if depth + self._inflight + n > self.ingest_budget:
+                self._overload.inc()
+                return 429, {"message":
+                             "Ingest backlog exceeds "
+                             f"PIO_INGEST_QUEUE_BUDGET={self.ingest_budget} "
+                             f"({depth} queued, {self._inflight} in "
+                             "flight); retry later."}
+            self._inflight += n
+        return None
+
+    def _release(self, n: int) -> None:
+        with self._inflight_lock:
+            self._inflight -= n
 
     def _note_ingest(self, app_id: int, evs) -> None:
         """Advance the per-app ingest high-watermark gauge after events
@@ -425,11 +545,15 @@ class EventServer:
         body, so replay after a long outage cannot re-stamp times."""
         events = self.storage.get_events()
         token = uuid.uuid4().hex
+        denied = self._admit(1)
+        if denied is not None:
+            return denied
         try:
             with idempotency_key(token):
                 event_id = self._breaker.call(
                     events.insert, ev, key_row.app_id, channel_id)
             self._note_ingest(key_row.app_id, [ev])
+            self._segment_tee(key_row.app_id, channel_id, [ev])
             return 201, {"eventId": event_id}
         except _UNAVAILABLE:
             spilled = self._spill_events([event_to_json(ev)],
@@ -439,6 +563,8 @@ class EventServer:
                 raise  # no journal → handle() maps to 503
             return 202, {"message": "Storage unavailable; event journaled "
                                     "for replay.", "token": spilled}
+        finally:
+            self._release(1)
 
     def _handle(self, method, path, params, body, headers) -> Tuple[int, Any]:
         if path == "/" and method == "GET":
@@ -448,16 +574,30 @@ class EventServer:
             # breaker closed.  503 tells the load balancer to rotate this
             # instance out while it probes recovery.
             st = self._breaker.state
-            body_ = {"status": "ready" if st == "closed" else "unavailable",
+            disk_degraded = self._segment_degraded or (
+                self.segments is not None and self.segments.disk_pressure())
+            status_word = ("unavailable" if st != "closed"
+                           else "degraded" if disk_degraded else "ready")
+            body_ = {"status": status_word,
                      "breaker": st,
                      "spillBackend": self.spill_backend,
                      "spillQueueDepth": self.spill.depth() if self.spill
-                     else 0}
+                     else 0,
+                     "ingestInflight": self._inflight,
+                     "ingestBudget": self.ingest_budget,
+                     "diskDegraded": disk_degraded}
             if self.shared_spill is not None:
                 # cached: a readiness probe must never block on a
                 # storage RPC while storage is the thing that is down
                 body_["sharedSpillDepth"] = \
                     self.shared_spill.cached_depth()
+            if self.segments is not None:
+                segs = self.segments.status()
+                body_["segmentDirs"] = len(segs)
+                body_["segmentCount"] = sum(s["segments"] for s in segs)
+            # Disk-degraded is still READY (200): the primary store and
+            # the spill journal keep accepting — only segment coverage
+            # stopped growing.  Operators see it; LBs keep routing.
             return (200 if st == "closed" else 503), body_
         if path == "/stats.json" and method == "GET":
             return 200, self.stats.snapshot()
@@ -489,16 +629,32 @@ class EventServer:
             return self._insert_one(ev, key_row, channel_id)
 
         if path == "/batch/events.json" and method == "POST":
-            arr = json.loads(body.decode("utf-8"))
+            arr = self._parse_batch_body(body, headers)
             if not isinstance(arr, list):
-                return 400, {"message": "Batch body must be a JSON array."}
-            if len(arr) > MAX_BATCH_SIZE:
+                return 400, {"message": "Batch body must be a JSON array "
+                                        "or NDJSON lines."}
+            cap = max_batch_size()
+            if len(arr) > cap:
                 return 400, {"message":
-                             f"Batch size exceeds the limit of {MAX_BATCH_SIZE}."}
+                             f"Batch size exceeds the limit of {cap}."}
+            # Client-supplied batch idempotency token (?batchToken=):
+            # sub-tokens derive deterministically from it, so a client
+            # RETRY of the whole batch produces the same event ids and
+            # dedups row-by-row — exactly-once from the SDK on down.
+            bt = params.get("batchToken", [None])[0]
+            if bt is not None and (len(bt) > 120
+                                   or not _TOKEN_RE.fullmatch(bt)):
+                return 400, {"message": "batchToken must be 1-120 chars "
+                                        "of [A-Za-z0-9._-]."}
             # Validate per item, then ONE group-committed insert for the
             # valid ones — per-item inserts each paid a transaction commit
             # (48 µs apiece measured), capping batch ingest at ~10k ev/s.
-            folded = self._fold_insert(key_row, channel_id, arr)
+            folded = self._fold_insert(key_row, channel_id, arr,
+                                       batch_token=bt)
+            if folded and all(s == 429 for s, _, _ in folded):
+                # whole batch refused at admission: answer at the HTTP
+                # layer so the transport attaches Retry-After
+                return 429, folded[0][1]
             return 200, [{"status": s, **p} for s, p, _ in folded]
 
         if path == "/events.json" and method == "GET":
@@ -549,13 +705,24 @@ class EventServer:
                     payload = json.loads(body.decode("utf-8"))
                 else:
                     payload = dict(parse_qsl(body.decode("utf-8")))
-                event_json = connector.to_event_json(payload)
-                ev = event_from_json(event_json)
+                # Burst coalescing (ISSUE 17): one provider delivery may
+                # carry N messages (segment.io batches) — ALL of them ride
+                # the batched-ingest fold as one group commit, never a
+                # per-row create_event loop.  Malformed messages inside a
+                # burst come back as Exception placeholders → per-item
+                # 400, the rest of the delivery lands.
+                items = connector.to_events_json(payload)
             except ConnectorError as e:
                 return 400, {"message": str(e)}
-            if key_row.events and ev.event not in key_row.events:
-                return 403, {"message": f"Event {ev.event!r} not allowed by this key."}
-            return self._insert_one(ev, key_row, channel_id)
+            if not items:
+                return 200, []
+            folded = self._fold_insert(key_row, channel_id, items)
+            if len(folded) == 1:
+                # single-event deliveries keep the historical one-object
+                # response shape (201 {"eventId": ...})
+                s, p, _ = folded[0]
+                return s, p
+            return 200, [{"status": s, **p} for s, p, _ in folded]
 
         if path.startswith("/events/") and path.endswith(".json"):
             event_id = path[len("/events/"):-len(".json")]
@@ -687,67 +854,125 @@ class EventServer:
     # (fold results carry the event name so the stats recorder does not
     # re-parse every body on the hot grouped-ingest path)
 
-    def _fold_insert(self, key_row, channel_id, items: List[Any]):
-        """THE batched-ingest fold, shared by /batch/events.json and the
-        native frontend's grouped singles: per-item validation against
-        the key's event allowlist, then ONE group-committed
-        ``insert_batch`` for the valid events.  ``items`` are parsed
-        event JSON objects; an Exception instance stands for a body that
-        failed to decode (reported per-item as 400).  Returns
-        ``(status, payload, event_name)`` triples."""
-        events = self.storage.get_events()
-        outs: List[Any] = [None] * len(items)
-        valid: List[Tuple[int, Any]] = []
-        for i, item in enumerate(items):
-            if isinstance(item, Exception):
-                outs[i] = (400, {"message": str(item)}, None)
+    @staticmethod
+    def _parse_batch_body(body: bytes, headers) -> Any:
+        """Decode a /batch/events.json body: a JSON array, or NDJSON —
+        one event object per line (Content-Type ``application/x-ndjson``
+        or any body whose first non-space byte is not ``[``).  A
+        malformed NDJSON line becomes an Exception placeholder so
+        ``_fold_insert`` answers it 400 PER-ITEM: one bad line never
+        fails its cohort.  (A malformed JSON *array* is still a
+        whole-request 400 — there are no item boundaries to salvage.)"""
+        ctype = (headers.get("Content-Type", "") if headers else "") or ""
+        text = body.decode("utf-8")
+        ndjson = "ndjson" in ctype.lower() or "jsonlines" in ctype.lower()
+        if not ndjson:
+            head = text.lstrip()[:1]
+            ndjson = bool(head) and head != "["
+        if not ndjson:
+            return json.loads(text)
+        items: List[Any] = []
+        for n, line in enumerate(text.splitlines(), start=1):
+            if not line.strip():
                 continue
             try:
-                ev = event_from_json(item)
-                if key_row.events and ev.event not in key_row.events:
-                    outs[i] = (403, {"message":
-                                     f"Event {ev.event!r} not allowed by "
-                                     "this key."}, None)
+                items.append(json.loads(line))
+            except ValueError as e:
+                items.append(ValueError(f"Invalid JSON on line {n}: {e}"))
+        return items
+
+    def _fold_insert(self, key_row, channel_id, items: List[Any],
+                     batch_token: Optional[str] = None):
+        """THE batched-ingest fold, shared by /batch/events.json, the
+        webhook burst path and the native frontend's grouped singles:
+        per-item validation against the key's event allowlist, then ONE
+        group-committed ``create_batch`` for the valid events.  ``items``
+        are parsed event JSON objects; an Exception instance stands for a
+        body that failed to decode (reported per-item as 400).  Returns
+        ``(status, payload, event_name)`` triples.
+
+        Exactly-once (ISSUE 17): the whole batch is covered by ONE
+        idempotency token (whole-call dedup at a hosted backend) plus a
+        per-item sub-token each — event ids derive from the sub-tokens,
+        so a replay after a crashed reply or a partial landing dedups
+        row-by-row instead of all-or-nothing.  On storage outage the
+        batch spills as ONE journal record carrying both token layers."""
+        denied = self._admit(len(items))
+        if denied is not None:
+            st, payload = denied
+            return [(st, payload, None)] * len(items)
+        try:
+            fault_point("ingest.batch")
+            events = self.storage.get_events()
+            outs: List[Any] = [None] * len(items)
+            valid: List[Tuple[int, Any]] = []
+            for i, item in enumerate(items):
+                if isinstance(item, Exception):
+                    outs[i] = (400, {"message": str(item)}, None)
                     continue
-                valid.append((i, ev))
-            except (EventValidationError, StorageError) as e:
-                outs[i] = (400, {"message": str(e)}, None)
-            except Exception:
-                logger.exception("ingest item failed")
-                outs[i] = (500, {"message": "Internal server error."}, None)
-        if valid:
-            token = uuid.uuid4().hex  # pinned BEFORE the attempt
-            try:
-                with idempotency_key(token):
-                    ids = self._breaker.call(
-                        events.insert_batch, [ev for _, ev in valid],
-                        key_row.app_id, channel_id)
-                for (i, ev), eid in zip(valid, ids):
-                    outs[i] = (201, {"eventId": eid}, ev.event)
-                self._note_ingest(key_row.app_id, [ev for _, ev in valid])
-            except _UNAVAILABLE as e:
-                # Mid-batch storage outage: EVERY valid item gets an
-                # explicit answer — spilled (202 + the batch's token)
-                # when the journal is on, 503 when it is not.  Never a
-                # partial silent drop.  The whole batch journals as ONE
-                # record under the token it was attempted with, so the
-                # replay re-issues the identical group insert.
-                spilled = self._spill_events(
-                    [event_to_json(ev) for _, ev in valid],
-                    key_row.app_id, channel_id, token)
-                for i, _ in valid:
-                    outs[i] = ((202, {"message": "Storage unavailable; "
-                                                 "event journaled for "
-                                                 "replay.",
-                                      "token": spilled}, None)
-                               if spilled is not None else
-                               (503, {"message": "Storage temporarily "
-                                                 f"unavailable: {e}"},
-                                None))
-            except StorageError as e:
-                for i, _ in valid:
+                try:
+                    ev = event_from_json(item)
+                    if key_row.events and ev.event not in key_row.events:
+                        outs[i] = (403, {"message":
+                                         f"Event {ev.event!r} not allowed "
+                                         "by this key."}, None)
+                        continue
+                    valid.append((i, ev))
+                except (EventValidationError, StorageError) as e:
                     outs[i] = (400, {"message": str(e)}, None)
-        return outs
+                except Exception:
+                    logger.exception("ingest item failed")
+                    outs[i] = (500, {"message": "Internal server error."},
+                               None)
+            if valid:
+                if batch_token is not None:
+                    # Deterministic sub-tokens from the CLIENT's token,
+                    # keyed by item position: a client retry of the same
+                    # batch re-derives the same event ids → per-item
+                    # dedup even when the first reply was lost.
+                    token = batch_token
+                    subtoks = [f"{batch_token}.{i}" for i, _ in valid]
+                else:
+                    token = uuid.uuid4().hex  # pinned BEFORE the attempt
+                    subtoks = [uuid.uuid4().hex for _ in valid]
+                try:
+                    with idempotency_key(token):
+                        ids = self._breaker.call(
+                            events.create_batch, [ev for _, ev in valid],
+                            key_row.app_id, channel_id, tokens=subtoks)
+                    for (i, ev), eid in zip(valid, ids):
+                        outs[i] = (201, {"eventId": eid}, ev.event)
+                    self._note_ingest(key_row.app_id,
+                                      [ev for _, ev in valid])
+                    self._segment_tee(key_row.app_id, channel_id,
+                                      [ev for _, ev in valid])
+                except _UNAVAILABLE as e:
+                    # Mid-batch storage outage: EVERY valid item gets an
+                    # explicit answer — spilled (202 + the batch's token)
+                    # when the journal is on, 503 when it is not.  Never
+                    # a partial silent drop.  The whole batch journals as
+                    # ONE record under the token it was attempted with
+                    # PLUS its per-item sub-tokens, so the replay
+                    # re-issues the identical create_batch and any rows
+                    # the crashed attempt already committed dedup away.
+                    spilled = self._spill_events(
+                        [event_to_json(ev) for _, ev in valid],
+                        key_row.app_id, channel_id, token, tokens=subtoks)
+                    for i, _ in valid:
+                        outs[i] = ((202, {"message":
+                                          "Storage unavailable; event "
+                                          "journaled for replay.",
+                                          "token": spilled}, None)
+                                   if spilled is not None else
+                                   (503, {"message":
+                                          "Storage temporarily "
+                                          f"unavailable: {e}"}, None))
+                except StorageError as e:
+                    for i, _ in valid:
+                        outs[i] = (400, {"message": str(e)}, None)
+            return outs
+        finally:
+            self._release(len(items))
 
     def start(self, block: bool = False) -> None:
         self._httpd = ThreadingHTTPServer((self.host, self.port),
@@ -775,6 +1000,13 @@ class EventServer:
             self._replay.stop()
         elif self.spill is not None:
             self.spill.close()
+        if self.segments is not None:
+            try:
+                # seal open windows so a clean shutdown leaves the full
+                # ingest history claimable by the next refresh read
+                self.segments.seal_all()
+            except Exception:
+                logger.exception("segment seal on shutdown failed")
         self.plugins.stop()
 
     def drain(self) -> None:

@@ -126,6 +126,11 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.xfail(
+    reason="jax CPU backend: 'Multiprocess computations aren't implemented "
+           "on the CPU backend' (XlaRuntimeError) — the gang forms, the "
+           "psum needs a real accelerator collective",
+    strict=False)
 def test_two_process_gang_forms_and_psums(tmp_path):
     port = _free_port()
     env_base = {

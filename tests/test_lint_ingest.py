@@ -1,0 +1,117 @@
+"""tools/lint_ingest.py: the ingest plane stays batched, segment files
+stay behind SegmentStore.
+
+ISSUE 17 satellite — the bulk endpoint and the columnar segment store
+only keep their guarantees while nobody reintroduces a per-row ingest
+loop or a second ad-hoc segment reader/writer; both regressions fail
+tier-1 structurally.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_ingest  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_ingest.check(REPO) == []
+
+
+def test_detects_create_event_in_ingest_plane():
+    src = """
+def relay(client, payload):
+    client.create_event(**payload)
+"""
+    violations = lint_ingest.check_source(
+        src, "t.py", ("webhooks", "forwarder.py"), in_ingest_plane=True)
+    assert len(violations) == 1
+    assert "create_batch" in violations[0]
+
+
+def test_create_event_allowed_outside_plane():
+    src = "def go(c, p):\n    c.create_event(**p)\n"
+    assert lint_ingest.check_source(
+        src, "sdk.py", ("predictionio_tpu", "sdk.py"),
+        in_ingest_plane=False) == []
+
+
+def test_detects_insert_loop_direct_chain():
+    src = """
+def land(storage, events, app_id):
+    for ev in events:
+        storage.get_events().insert(ev, app_id)
+"""
+    violations = lint_ingest.check_source(
+        src, "t.py", ("server", "event_server.py"), in_ingest_plane=True)
+    assert len(violations) == 1
+    assert "loop" in violations[0]
+
+
+def test_detects_insert_loop_split_chain():
+    src = """
+def land(storage, events, app_id):
+    repo = storage.get_events()
+    for ev in events:
+        repo.insert(ev, app_id)
+"""
+    violations = lint_ingest.check_source(
+        src, "t.py", ("server", "event_server.py"), in_ingest_plane=True)
+    assert len(violations) == 1
+
+
+def test_single_insert_outside_loop_passes():
+    # one row landing one row is fine — only the LOOP is the regression
+    src = """
+def land_one(storage, ev, app_id):
+    storage.get_events().insert(ev, app_id)
+"""
+    assert lint_ingest.check_source(
+        src, "t.py", ("server", "event_server.py"),
+        in_ingest_plane=True) == []
+
+
+def test_helper_defined_in_loop_is_not_a_loop_call():
+    src = """
+def build(storage, app_ids):
+    fns = []
+    for app_id in app_ids:
+        def _f(ev, a=app_id):
+            return storage.get_events().insert(ev, a)
+        fns.append(_f)
+    return fns
+"""
+    assert lint_ingest.check_source(
+        src, "t.py", ("server", "event_server.py"),
+        in_ingest_plane=True) == []
+
+
+def test_detects_raw_segment_open():
+    src = """
+def peek(path):
+    with open(path + ".seg", "rb") as f:
+        return f.read()
+"""
+    violations = lint_ingest.check_source(
+        src, "t.py", ("refresh", "daemon.py"), in_ingest_plane=False)
+    assert len(violations) == 1
+    assert "SegmentStore" in violations[0]
+
+
+def test_detects_fstring_segment_open():
+    src = """
+def peek(d, seq):
+    return open(f"{d}/seg-{seq}.seg", "rb").read()
+"""
+    violations = lint_ingest.check_source(
+        src, "t.py", ("server", "event_server.py"), in_ingest_plane=True)
+    assert len(violations) == 1
+
+
+def test_columnar_may_open_segments():
+    src = "def rd(p):\n    return open(str(p) + '.seg', 'rb').read()\n"
+    assert lint_ingest.check_source(
+        src, "columnar.py", ("data", "columnar.py"),
+        in_ingest_plane=False) == []

@@ -343,18 +343,40 @@ class TestWarmFallbacks:
         assert reason_fragment in events[-1]["attrs"]["reason"]
         return inst
 
-    def test_als_declines_and_falls_back(self, ctx):
+    def _als_gen1(self, ctx):
         app_id = _mk_app(ctx)
         _seed_clique_rates(ctx, app_id)
         eng, variant = _als()
         iid1 = run_train(eng, variant, ctx)
         inst1 = ctx.storage.get_engine_instances().get(iid1)
         ctx.storage.get_events().insert(_rate(0, 1, 5.0), app_id)
+        return app_id, eng, variant, inst1
+
+    def test_als_rank_change_falls_back(self, ctx):
+        app_id, eng, variant, inst1 = self._als_gen1(ctx)
         warm = _warm_ctx(ctx, eng, variant, inst1)
-        inst2 = self._assert_fallback(ctx, eng, variant, warm,
-                                      "warm-start continuation")
+        v2 = json.loads(json.dumps(ALS_VARIANT))
+        v2["algorithms"][0]["params"]["rank"] = 16
+        inst2 = self._assert_fallback(ctx, eng, EngineVariant.from_dict(v2),
+                                      warm, "config changed")
         # the fallback still covers the delta: it IS a fresh full corpus
         assert data_watermark(inst2) > data_watermark(inst1)
+
+    def test_als_eval_regression_falls_back(self, ctx):
+        # tolerance -1 → allowed regression threshold 0: the sweep's
+        # residual on the delta sample reads as a regression — pins the
+        # ALS eval gate path itself
+        app_id, eng, variant, inst1 = self._als_gen1(ctx)
+        warm = _warm_ctx(ctx, eng, variant, inst1, eval_tolerance=-1.0)
+        self._assert_fallback(ctx, eng, variant, warm, "regressed")
+
+    def test_als_unsized_carry_falls_back(self, ctx):
+        """A pre-ISSUE-17 pickle has no n_examples — the fraction gate
+        cannot be computed, so the carry declines instead of guessing."""
+        app_id, eng, variant, inst1 = self._als_gen1(ctx)
+        warm = _warm_ctx(ctx, eng, variant, inst1)
+        warm.models[0].n_examples = 0
+        self._assert_fallback(ctx, eng, variant, warm, "vs 0 trained")
 
     def test_oversized_delta_falls_back(self, ctx):
         app_id, eng, variant, inst = self._gen1(ctx)
@@ -418,6 +440,84 @@ class TestWarmFallbacks:
                           params, warm=warm)
         finally:
             eng.make_algorithms = real
+
+
+# ==========================================================================
+# ALS delta warm-start (ISSUE 17)
+# ==========================================================================
+
+class TestALSWarmStart:
+    def _gen1(self, ctx):
+        app_id = _mk_app(ctx)
+        _seed_clique_rates(ctx, app_id)
+        eng, variant = _als()
+        iid = run_train(eng, variant, ctx)
+        inst = ctx.storage.get_engine_instances().get(iid)
+        return app_id, eng, variant, inst
+
+    def test_warm_refresh_moves_only_delta_touched_rows(self, ctx):
+        """Factor-init + reduced-sweep retrain end-to-end: the warm
+        generation completes as ``warm``, the delta-touched user's factor
+        row moves, every untouched row carries over bit-for-bit, and the
+        new taste is immediately servable."""
+        app_id, eng, variant, inst1 = self._gen1(ctx)
+        models1 = load_models(eng, inst1, ctx)
+        algo = eng.make_algorithms(eng.bind_engine_params(variant.raw))[0]
+        # u0 (even clique) suddenly loves ODD items, hard
+        ctx.storage.get_events().insert_batch(
+            [_rate(0, 1, 5.0), _rate(0, 3, 5.0), _rate(0, 5, 5.0)], app_id)
+        warm = _warm_ctx(ctx, eng, variant, inst1)
+        iid2 = run_train(eng, variant, ctx, warm_from=warm)
+        inst2 = ctx.storage.get_engine_instances().get(iid2)
+        assert inst2.status == "COMPLETED"
+        assert inst2.env["refreshMode"] == "warm"
+        assert data_watermark(inst2) > data_watermark(inst1)
+        w1, w2 = models1[0], load_models(eng, inst2, ctx)[0]
+        uf1, if1 = w1.host_factors()
+        uf2, if2 = w2.host_factors()
+        u_rows = dict(w1.user_index.items())
+        i_rows = dict(w1.item_index.items())
+        moved_u = {u_rows["u0"]}
+        moved_i = {i_rows[f"i{j}"] for j in (1, 3, 5)}
+        for r in range(uf1.shape[0]):
+            if r in moved_u:
+                assert not np.array_equal(uf2[r], uf1[r])
+            else:
+                np.testing.assert_array_equal(uf2[r], uf1[r])
+        for r in range(if1.shape[0]):
+            if r not in moved_i:
+                np.testing.assert_array_equal(if2[r], if1[r])
+        assert w2.n_examples == w1.n_examples + 3
+        # the new taste serves: an odd item reaches u0's top-3
+        from predictionio_tpu.templates.recommendation import Query
+
+        top = algo.predict(w2, Query(user="u0", num=3)).itemScores
+        assert any(int(s.item[1:]) % 2 == 1 for s in top)
+
+    def test_warm_refresh_grows_union_index_for_new_entities(self, ctx):
+        """Delta-new user AND item get fresh appended rows; the new user
+        is non-cold immediately after the warm refresh."""
+        app_id, eng, variant, inst1 = self._gen1(ctx)
+        ctx.storage.get_events().insert_batch(
+            [_rate(99, 0, 5.0), _rate(99, 2, 5.0),
+             _rate(99, 99, 4.0)], app_id)  # u99 and i99 are brand new
+        warm = _warm_ctx(ctx, eng, variant, inst1)
+        iid2 = run_train(eng, variant, ctx, warm_from=warm)
+        inst2 = ctx.storage.get_engine_instances().get(iid2)
+        assert inst2.env["refreshMode"] == "warm"
+        w1 = load_models(eng, inst1, ctx)[0]
+        w2 = load_models(eng, inst2, ctx)[0]
+        assert "u99" in dict(w2.user_index.items())
+        assert "i99" in dict(w2.item_index.items())
+        # union-extend: previous ids keep their exact rows
+        assert dict(w2.user_index.items())["u99"] == len(w1.user_index)
+        for key, row in w1.item_index.items():
+            assert dict(w2.item_index.items())[key] == row
+        algo = eng.make_algorithms(eng.bind_engine_params(variant.raw))[0]
+        from predictionio_tpu.templates.recommendation import Query
+
+        res = algo.predict(w2, Query(user="u99", num=4))
+        assert len(res.itemScores) == 4  # non-cold without a full retrain
 
 
 # ==========================================================================
@@ -570,13 +670,13 @@ class TestDaemon:
         assert promoter.promoted == [out1["instance"]]
         ctx.storage.get_events().insert(_rate(0, 1, 5.0), app_id)
         out2 = d.run_once()
-        assert out2["result"] == "full_fallback"  # ALS declines warm
+        assert out2["result"] == "warm"  # ALS continues the generation
         assert promoter.promoted[-1] == out2["instance"]
         assert promoter.watched == 2
         reg = get_registry()
         runs = reg.get("pio_refresh_runs_total")
         assert runs.value(result="full") == 1
-        assert runs.value(result="full_fallback") == 1
+        assert runs.value(result="warm") == 1
         promos = reg.get("pio_refresh_promotions_total")
         assert promos.value(result="promoted") == 2
         # staleness gauge: everything ingested before the watermark is

@@ -48,7 +48,8 @@ class TestEventClient:
             {"event": "view", "entityType": "user", "entityId": "u2",
              "targetEntityType": "item", "targetEntityId": "i2"},
         ])
-        assert [r["status"] for r in res] == [201, 201]
+        assert [r.status for r in res] == [201, 201]
+        assert all(r.stored and r.event_id == str(r) for r in res)
         c.set_user("u3", {"age": 30})
         assert c.find_events(entityId="u3")[0]["properties"]["age"] == 30
 

@@ -187,6 +187,71 @@ class TestEventsContract:
         tbl = ev.find_columnar(APP, until_time=w)
         assert tbl.num_rows == 1
 
+    # -- bulk-ingest create_batch contract (ISSUE 17) ------------------
+
+    def test_create_batch_lands_all_rows(self, events_backend):
+        ev = events_backend
+        ev.init(APP)
+        ids = ev.create_batch(
+            [
+                _mk("view", "u1", "2026-01-01T00:00:00", target="i1"),
+                _mk("buy", "u2", "2026-01-02T00:00:00", target="i2"),
+            ],
+            APP,
+            tokens=["tokA.0", "tokA.1"],
+        )
+        assert len(ids) == 2 and len(set(ids)) == 2
+        got = [ev.get(i, APP) for i in ids]
+        assert [g.event for g in got] == ["view", "buy"]
+        assert len(list(ev.find(APP))) == 2
+
+    def test_create_batch_replay_is_idempotent(self, events_backend):
+        """The exactly-once core: replaying the SAME sub-tokens (a client
+        retry after a crashed reply, a journal replay after restart)
+        lands each row at most once and returns the same ids."""
+        ev = events_backend
+        ev.init(APP)
+        events = [
+            _mk("view", "u1", "2026-01-01T00:00:00", target="i1"),
+            _mk("buy", "u2", "2026-01-02T00:00:00", target="i2"),
+        ]
+        toks = ["replay.0", "replay.1"]
+        first = ev.create_batch(events, APP, tokens=toks)
+        second = ev.create_batch(events, APP, tokens=toks)
+        assert first == second
+        assert len(list(ev.find(APP))) == 2
+
+    def test_create_batch_partial_landing_replays_per_item(
+            self, events_backend):
+        """A crash can leave HALF a batch committed (the reply was lost
+        either way).  Dedup is per-item, not per-batch: the replay must
+        fill in only the missing rows."""
+        ev = events_backend
+        ev.init(APP)
+        events = [
+            _mk("view", "u1", "2026-01-01T00:00:00", target="i1"),
+            _mk("buy", "u2", "2026-01-02T00:00:00", target="i2"),
+        ]
+        toks = ["part.0", "part.1"]
+        # simulate the partial landing: only item 0 committed
+        ev.create_batch(events[:1], APP, tokens=toks[:1])
+        assert len(list(ev.find(APP))) == 1
+        ids = ev.create_batch(events, APP, tokens=toks)
+        assert len(ids) == 2
+        all_ev = list(ev.find(APP))
+        assert len(all_ev) == 2, "replay must add ONLY the missing row"
+        assert sorted(e.event for e in all_ev) == ["buy", "view"]
+
+    def test_create_batch_without_tokens_still_lands(self, events_backend):
+        # tokens are optional — an untokened call degrades to plain
+        # multi-row insert semantics (at-least-once, server-generated ids)
+        ev = events_backend
+        ev.init(APP)
+        ids = ev.create_batch(
+            [_mk("view", "u1", "2026-01-01T00:00:00", target="i1")], APP)
+        assert len(ids) == 1
+        assert ev.get(ids[0], APP).event == "view"
+
     def test_time_window_naive_bounds_mean_utc(self, events_backend):
         """A NAIVE window bound means the same instant as the aware-UTC
         stamp on every backend (the shared epoch_us rule) — a daemon

@@ -37,6 +37,25 @@ class TestSegmentIO:
         with pytest.raises(ConnectorError):
             SegmentIOConnector().to_event_json({"type": "track", "event": "x"})
 
+    def test_batch_delivery_coalesces(self):
+        """Segment's ``{"batch": [...]}`` envelope → one event list; a
+        malformed message inside the burst becomes a per-item
+        ConnectorError placeholder, not a whole-delivery failure."""
+        out = SegmentIOConnector().to_events_json({"batch": [
+            {"type": "track", "userId": "u1", "event": "a"},
+            {"type": "track", "event": "no-user"},
+            {"type": "identify", "userId": "u2", "traits": {"x": 1}},
+        ]})
+        assert len(out) == 3
+        assert out[0]["event"] == "a"
+        assert isinstance(out[1], ConnectorError)
+        assert out[2]["event"] == "$set"
+
+    def test_single_delivery_still_wraps(self):
+        out = SegmentIOConnector().to_events_json(
+            {"type": "track", "userId": "u1", "event": "a"})
+        assert len(out) == 1 and out[0]["event"] == "a"
+
 
 class TestMailchimp:
     def test_subscribe(self):
@@ -98,6 +117,41 @@ def test_webhook_form_route(server):
         assert r.status == 201
     evs = list(storage.get_events().find(app_id, entity_id="a@b.c"))
     assert len(evs) == 1 and evs[0].event == "subscribe"
+
+
+def test_webhook_batch_route_one_group_commit(server):
+    """A segment.io batch delivery rides the batched-ingest fold: ONE
+    storage round trip, per-item statuses, the malformed message answers
+    its own 400 while the rest of the burst lands."""
+    import unittest.mock as mock
+
+    srv, key, storage, app_id = server
+    events_repo = storage.get_events()
+    real = type(events_repo).create_batch
+    calls = []
+
+    def counting(self, evs, *a, **kw):
+        calls.append(len(evs))
+        return real(self, evs, *a, **kw)
+
+    payload = {"batch": [
+        {"type": "track", "userId": "b1", "event": "buy"},
+        {"type": "track", "event": "missing-user"},
+        {"type": "track", "userId": "b2", "event": "view"},
+    ]}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/webhooks/segmentio.json?accessKey={key}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with mock.patch.object(type(events_repo), "create_batch", counting):
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            results = json.loads(r.read())
+    assert [it["status"] for it in results] == [201, 400, 201]
+    assert "userId" in results[1]["message"]
+    assert calls == [2], "burst must land as ONE group commit"
+    assert len(list(storage.get_events().find(app_id, entity_id="b1"))) == 1
+    assert len(list(storage.get_events().find(app_id, entity_id="b2"))) == 1
 
 
 def test_webhook_bad_connector_404ish(server):

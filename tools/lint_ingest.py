@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Static check: the ingest plane stays batched and the segment files
+stay behind their one reader/writer.
+
+ISSUE 17 builds a bulk ingest path (``POST /batch/events.json`` →
+``Events.create_batch`` — ONE storage round-trip per batch) and an
+append-only columnar segment store with a CRC-block wire format and a
+crash-safe manifest.  The two regressions such a plane invites are
+structural, so this lint makes them tier-1 failures:
+
+1. **No per-row ingest in the serving plane** — inside
+   ``predictionio_tpu/server/`` and ``predictionio_tpu/data/webhooks/``:
+
+   - any ``<x>.create_event(...)`` call is banned outright (that is the
+     SDK's single-row client verb; server-side code coalesces through
+     the batched fold / ``create_batch``), and
+   - an ``.insert(...)`` call on an events repository — the direct
+     ``get_events().insert(...)`` chain or a variable bound from
+     ``get_events()`` — is banned *lexically inside a loop or
+     comprehension*.  A row-at-a-time insert loop silently reintroduces
+     N round-trips, N journal records, and N segment tees per burst;
+     the batch entry points exist precisely so this never comes back.
+
+2. **Segment files are opened only by ``data/columnar.py``** — a raw
+   ``open(...)`` (or ``.open(...)``) call whose literal arguments
+   mention the ``.seg`` suffix is banned everywhere else.  The segment
+   wire format (magic, CRC-framed blocks, torn-tail recovery, manifest
+   commit point) has exactly one implementation; a second ad-hoc reader
+   or writer would fork the crash-safety contract.
+
+Usage: ``python tools/lint_ingest.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# Directories whose modules form the serving-plane ingest path (rule 1):
+# (parent-dir name, ...) membership is checked against path.parts.
+_INGEST_PLANE_DIRS = ("server", "webhooks")
+# The one module allowed to open segment files (rule 2).
+_SEGMENT_OK = ("data", "columnar.py")
+_SEGMENT_SUFFIX = ".seg"
+
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _rel_key(path: Path) -> tuple:
+    return (path.parent.name, path.name)
+
+
+def _events_bound_names(tree: ast.AST) -> set:
+    """Variables assigned from a ``get_events()`` call anywhere in the
+    module — ``repo = storage.get_events(); repo.insert(...)`` must not
+    dodge the loop rule by splitting the chain."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "get_events":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def _is_events_insert(call: ast.Call, bound: set) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "insert"):
+        return False
+    if isinstance(f.value, ast.Call) \
+            and isinstance(f.value.func, ast.Attribute) \
+            and f.value.func.attr == "get_events":
+        return True  # direct get_events().insert chain
+    return isinstance(f.value, ast.Name) and f.value.id in bound
+
+
+def _row_calls_in_loops(tree: ast.AST, bound: set) -> List[tuple]:
+    """``(lineno, kind)`` for per-row ingest calls lexically inside a
+    loop/comprehension (rule 1's loop half)."""
+    out: List[tuple] = []
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(child, _LOOP_NODES)
+            if in_loop and isinstance(child, ast.Call) \
+                    and _is_events_insert(child, bound):
+                out.append((child.lineno, "insert"))
+            # a nested function body resets the loop context — a helper
+            # DEFINED in a loop is not itself an ingest loop
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                walk(child, False)
+            else:
+                walk(child, child_in_loop)
+
+    walk(tree, False)
+    return out
+
+
+def _mentions_segment_suffix(node: ast.AST) -> bool:
+    """Any string literal under ``node`` (plain or f-string part)
+    containing the segment suffix."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _SEGMENT_SUFFIX in sub.value:
+            return True
+    return False
+
+
+def _is_open_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return True
+    return isinstance(f, ast.Attribute) and f.attr == "open"
+
+
+def check_source(source: str, filename: str, rel_key: tuple,
+                 in_ingest_plane: bool) -> List[str]:
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+
+    segment_ok = rel_key == _SEGMENT_OK
+    if in_ingest_plane:
+        bound = _events_bound_names(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "create_event":
+                violations.append(
+                    f"{filename}:{node.lineno}: per-row create_event() in "
+                    f"the ingest plane — bursts coalesce through the "
+                    f"batched fold (POST /batch/events.json → "
+                    f"Events.create_batch), never a single-row client "
+                    f"verb")
+        for lineno, _ in _row_calls_in_loops(tree, bound):
+            violations.append(
+                f"{filename}:{lineno}: events .insert() inside a loop — "
+                f"a row-at-a-time insert loop pays N round-trips and N "
+                f"journal records per burst; use create_batch / "
+                f"insert_batch (one group commit)")
+    if not segment_ok:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_open_call(node) \
+                    and any(_mentions_segment_suffix(a)
+                            for a in list(node.args)
+                            + [kw.value for kw in node.keywords]):
+                violations.append(
+                    f"{filename}:{node.lineno}: raw open() on a "
+                    f"'{_SEGMENT_SUFFIX}' segment file — the CRC-framed "
+                    f"wire format and torn-tail recovery live only in "
+                    f"data/columnar.py; read segments through "
+                    f"SegmentStore")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    pkg = root / "predictionio_tpu"
+    violations: List[str] = []
+    for path in sorted(pkg.rglob("*.py")):
+        in_plane = any(part in _INGEST_PLANE_DIRS for part in path.parts)
+        violations.extend(check_source(
+            path.read_text(encoding="utf-8"), str(path), _rel_key(path),
+            in_plane))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} ingest-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_ingest: ingest stays batched; segment files stay behind "
+          "SegmentStore.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -330,6 +330,7 @@ def _print_serving_snapshot(lines) -> None:
     refresh_runs = {}
     quality = {}
     recall = {}
+    rcache = {}
 
     def _b(model):
         return batcher.setdefault(model, {})
@@ -371,6 +372,20 @@ def _print_serving_snapshot(lines) -> None:
             recall["tripped"] = True
         elif name == "pio_retrieval_recall_reporting_only" and value > 0:
             recall["reporting_only"] = True
+        elif name == "pio_result_cache_hits_total":
+            rcache["hits"] = rcache.get("hits", 0) + int(value)
+        elif name == "pio_result_cache_misses_total":
+            rcache["misses"] = int(value)
+        elif name == "pio_result_cache_hit_rate":
+            rcache["hit_rate"] = value
+        elif name == "pio_result_cache_entries":
+            rcache["entries"] = int(value)
+        elif name == "pio_result_cache_bytes":
+            rcache["bytes"] = int(value)
+        elif name == "pio_result_cache_evictions_total" and value > 0:
+            rcache["evictions"] = int(value)
+        elif name == "pio_result_cache_shared_errors_total" and value > 0:
+            rcache["shared_errors"] = int(value)
         elif name == "pio_model_reload_total":
             reloads[labels.get("result", "?")] = int(value)
         elif name == "pio_breaker_state":
@@ -395,7 +410,7 @@ def _print_serving_snapshot(lines) -> None:
             shed[labels.get("reason", "?")] = int(value)
     if generation is None and not reloads and not breakers and not batcher \
             and not latest_ts and not refresh_runs and staleness is None \
-            and not quality and not recall:
+            and not quality and not recall and not rcache:
         return
     if generation is not None:
         print(f"serving: model generation {generation}")
@@ -449,6 +464,25 @@ def _print_serving_snapshot(lines) -> None:
         if parts:
             k = recall.get("k", "?")
             print(f"  recall@{k}: {', '.join(parts)}")
+    # Result cache (ISSUE 20): the serve fast path — hit rate, residency,
+    # and whether the shared tier is degrading to local-only.
+    if rcache:
+        parts = []
+        if "hit_rate" in rcache:
+            parts.append(f"hit-rate {rcache['hit_rate']:.3f}")
+        if "hits" in rcache or "misses" in rcache:
+            parts.append(f"hits {rcache.get('hits', 0)}"
+                         f"/misses {rcache.get('misses', 0)}")
+        if "entries" in rcache:
+            parts.append(f"entries {rcache['entries']}")
+        if "bytes" in rcache:
+            parts.append(f"{rcache['bytes'] / 1024:.0f}KiB")
+        if rcache.get("evictions"):
+            parts.append(f"evictions {rcache['evictions']}")
+        if rcache.get("shared_errors"):
+            parts.append(f"SHARED-TIER ERRORS {rcache['shared_errors']}")
+        if parts:
+            print(f"  result cache: {', '.join(parts)}")
     if reloads:
         parts = ", ".join(f"{k}={v}" for k, v in sorted(reloads.items()))
         print(f"  model reloads: {parts}")

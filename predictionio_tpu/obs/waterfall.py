@@ -13,6 +13,9 @@ ingress     transport receipt → bind start (socket body read, trace
 queue_wait  admission → a batcher gather picked the entry up
 batch_wait  gather pickup → dispatch start (window / deadline-close wait)
 bind        JSON parse + query-dataclass bind (handler thread)
+cache       result-cache key canonicalization + lookup (ISSUE 20; on a
+            hit this is the ONLY serving stage — queue/dispatch never
+            run — so attribution stays honest about the fast path)
 dispatch    the ONE vectorized model dispatch the batch shared
 resume      dispatch done → the handler thread actually running again
             (event wake-up under GIL/thread contention)
@@ -72,20 +75,21 @@ __all__ = [
     "transport_start",
 ]
 
-SERVE_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "dispatch",
-                "resume", "retrieval", "serialize", "shed_check")
+SERVE_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
+                "dispatch", "resume", "retrieval", "serialize",
+                "shed_check")
 # The additive stages: their sum should reconcile with the request's
 # total wall (retrieval is a sub-component of dispatch; resume is the
 # handler thread's post-dispatch wake-up — event set → actually running
 # again under GIL/thread contention).
-WALL_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "dispatch",
-               "resume", "serialize", "shed_check")
+WALL_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
+               "dispatch", "resume", "serialize", "shed_check")
 # The stages the server-attested X-PIO-Server-Ms wall CONTAINS: the
 # attestation header is read before the response is written (headers
 # must be assembled first), so serialize — the respond/socket write —
 # lies outside it by construction.  Reconciling against the attestation
 # must sum exactly these.
-ATTESTED_STAGES = ("ingress", "queue_wait", "batch_wait", "bind",
+ATTESTED_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
                    "dispatch", "resume", "shed_check")
 
 

@@ -58,10 +58,13 @@ from predictionio_tpu.server.http import (
 from predictionio_tpu.config import env_bool
 from predictionio_tpu.serving import (
     QueueFull,
+    ResultCache,
+    ResultCacheConfig,
     SchedulerClosed,
     SchedulerConfig,
     SchedulerStalled,
     ServingScheduler,
+    canonical_query,
 )
 from predictionio_tpu.version import __version__
 from predictionio_tpu.workflow.core_workflow import (
@@ -295,6 +298,21 @@ class EngineServer:
         # verdict.  PIO_RECALL=off registers zero instruments and can
         # never block a promotion.
         self.recall = RecallMonitor(registry=reg)
+        # Serve-side result cache (ISSUE 20): the FIRST stop on the query
+        # path, keyed by (generation fingerprint, canonical query) so every
+        # reload/rollback invalidates by construction.  The optional fleet
+        # tier rides the PR-13 shared KV; a missing/broken KV degrades to
+        # the per-instance LRU, never fails construction.
+        cache_cfg = ResultCacheConfig.from_env()
+        cache_kv = None
+        if cache_cfg.shared and getattr(self, "storage", None) is not None:
+            try:
+                cache_kv = self.storage.get_kv()
+            except Exception:
+                logger.warning("result cache: shared tier unavailable; "
+                               "running local-only", exc_info=True)
+        self.result_cache = ResultCache(cache_cfg, registry=reg,
+                                        kv=cache_kv)
 
     def _load_candidate(self, target_instance_id: Optional[str] = None):
         """Storage-read phase of the staged reload (runs under the
@@ -430,6 +448,9 @@ class EngineServer:
             # retriever hook and judge it against its own baked recall
             # scorecard (never the predecessor's).
             self.recall.on_generation(gen, models)
+            # Result cache (ISSUE 20): the new instance id becomes the key
+            # fingerprint — every pre-swap entry misses by construction.
+            self.result_cache.on_generation(gen, instance.id)
             self._arm_eviction(gen)
             self._record_reload("ok", instance=instance.id, generation=gen)
             logger.info("Engine server loaded instance %s (generation %d)",
@@ -466,6 +487,9 @@ class EngineServer:
             # the RESTORED generation's own scorecard.
             self.quality.on_generation(gen, restored_models)
             self.recall.on_generation(gen, restored_models)
+            # Restoring the previous instance id revalidates its surviving
+            # cache entries for free — the fingerprint IS the key.
+            self.result_cache.on_generation(gen, instance_id)
             # The rolled-from generation now sits in the previous slot;
             # it ages out on the same TTL as any other retained one.
             self._arm_eviction(gen)
@@ -641,6 +665,7 @@ class EngineServer:
                     "retainPreviousTtlS": self._retain_ttl_s or None,
                     "breaker": self._breaker.state,
                     "batcher": self.scheduler.snapshot(),
+                    "resultCache": self.result_cache.snapshot(),
                     "slo": self.slo.snapshot(),
                     "version": __version__,
                 }
@@ -675,6 +700,7 @@ class EngineServer:
                 wm = data_watermark(inst) if inst else None
                 return 200, {**self.stats.snapshot(),
                              "batcher": self.scheduler.snapshot(),
+                             "resultCache": self.result_cache.snapshot(),
                              "slo": self.slo.snapshot(),
                              "quality": self.quality.summary(),
                              "dataWatermark": wm.isoformat() if wm
@@ -777,6 +803,51 @@ class EngineServer:
                               if self.recall.enabled else None)
                     if wf is not None and u is not None:
                         wf.sample_u = u
+                    # Result cache (ISSUE 20): the first stop after bind.
+                    # A hit bypasses admission/batching entirely but
+                    # stamps the `cache` stage with the FILL generation —
+                    # attribution and the serve-id describe the answer
+                    # actually served — and rides the same quality record
+                    # stream as a dispatched request, so a 95%-hit-rate
+                    # drive still feeds the drift windows.  The lookup
+                    # cost is stamped on misses too: it is real wall the
+                    # attestation contains.
+                    canon = None
+                    if self.result_cache.enabled:
+                        tc = time.perf_counter()
+                        try:
+                            canon = canonical_query(q)
+                        except TypeError:
+                            canon = None  # uncacheable query shape
+                        hit = (self.result_cache.lookup(canon)
+                               if canon is not None else None)
+                        _waterfall.record_stage(
+                            "cache", (time.perf_counter() - tc) * 1e3,
+                            cacheHit=hit is not None)
+                        if hit is not None:
+                            if wf is not None:
+                                wf.note(generation=hit.generation,
+                                        cacheTier=hit.tier,
+                                        cacheAgeS=round(hit.age_s, 3))
+                                wf.mark("handler_done")
+                            # Same never-late-200 gate as the dispatch
+                            # path: a hit found past the budget still
+                            # sheds.
+                            _deadline.check("respond")
+                            # Parse the document only when this request
+                            # is quality-sampled (same gate observe
+                            # applies): an unsampled hit serves the
+                            # cached bytes untouched.
+                            if (u is not None and self.quality.enabled
+                                    and u < self.quality.config.sample):
+                                sid = self.quality.observe(
+                                    q, hit.result, hit.generation, u)
+                                if sid is not None and wf is not None:
+                                    wf.note(serveId=sid)
+                            self.stats.record(
+                                (time.perf_counter() - t0) * 1e3, True)
+                            return (200, hit.result_bytes,
+                                    "application/json; charset=UTF-8")
                     try:
                         result = self.scheduler.submit_and_wait(
                             "default", q)
@@ -787,6 +858,17 @@ class EngineServer:
                         # write is accounted, not lost.
                         if wf is not None:
                             wf.mark("handler_done")
+                    # Cache fill at the scheduler hand-back, under the
+                    # generation the batcher STAMPED at dispatch — never
+                    # "current" — so a mid-flight swap can't cache
+                    # generation A's answer under B's key.  Before the
+                    # respond gate: a result that arrives past its budget
+                    # still warms the cache for the retry.
+                    if canon is not None:
+                        self.result_cache.fill(
+                            canon, result,
+                            wf.attr("generation") if wf is not None
+                            else None)
                     # Final gate: a result that arrived past its own
                     # deadline is never served as a slow 200 — the
                     # client's budget is spent, so it gets the same 504

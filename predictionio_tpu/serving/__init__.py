@@ -62,10 +62,20 @@ from predictionio_tpu.serving.queue import (
     SchedulerClosed,
     SchedulerStalled,
 )
+from predictionio_tpu.serving.result_cache import (
+    CacheHit,
+    ResultCache,
+    ResultCacheConfig,
+    canonical_query,
+)
 
 __all__ = [
     "SchedulerConfig",
     "ServingScheduler",
+    "ResultCache",
+    "ResultCacheConfig",
+    "CacheHit",
+    "canonical_query",
     "MicroBatcher",
     "WindowAutotuner",
     "ModelQueue",

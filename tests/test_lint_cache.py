@@ -1,0 +1,140 @@
+"""tools/lint_cache.py: ONE result-cache seam on the serve path.
+
+ISSUE 20 satellite — locks in the tentpole's invalidation-by-construction
+guarantee: engine query results reach the transport only through the
+cache facade's lookup/fill seam, no handler-side memoization survives a
+generation swap, and the ``pio_result_cache_*`` family registers only in
+``serving/result_cache.py``.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_cache  # noqa: E402
+
+
+def test_tree_is_clean():
+    assert lint_cache.check(REPO) == []
+
+
+def test_detects_submit_without_lookup_or_fill():
+    """Rule 1: a submit_and_wait in the engine server that skips either
+    half of the seam is flagged — one violation per missing half."""
+    src = """
+class EngineServer:
+    def handle(self, method, path, body):
+        result = self.scheduler.submit_and_wait("default", body)
+        return 200, result
+"""
+    violations = lint_cache.check_source(
+        src, "predictionio_tpu/server/engine_server.py")
+    assert len(violations) == 2
+    assert any("lookup" in v for v in violations)
+    assert any("fill" in v for v in violations)
+
+
+def test_seam_ordering_matters():
+    """A lookup AFTER the submit (or a fill before) is not a seam."""
+    src = """
+class EngineServer:
+    def handle(self, method, path, body):
+        self.result_cache.fill(canon, None, 1)
+        result = self.scheduler.submit_and_wait("default", body)
+        self.result_cache.lookup(canon)
+        return 200, result
+"""
+    violations = lint_cache.check_source(
+        src, "predictionio_tpu/server/engine_server.py")
+    assert len(violations) == 2
+
+
+def test_proper_seam_is_clean():
+    src = """
+class EngineServer:
+    def handle(self, method, path, body):
+        hit = self.result_cache.lookup(canon)
+        if hit is not None:
+            return 200, hit.result
+        result = self.scheduler.submit_and_wait("default", body)
+        self.result_cache.fill(canon, result, gen)
+        return 200, result
+"""
+    assert lint_cache.check_source(
+        src, "predictionio_tpu/server/engine_server.py") == []
+
+
+def test_seam_rule_only_binds_the_engine_server():
+    """The scheduler's own internals (and other servers) call
+    submit_and_wait legitimately without the seam."""
+    src = """
+class Driver:
+    def run(self, q):
+        return self.scheduler.submit_and_wait("default", q)
+"""
+    assert lint_cache.check_source(
+        src, "predictionio_tpu/serving/__init__.py") == []
+
+
+def test_detects_functools_memoization_on_serve_path():
+    """Rule 2: lru_cache/functools.cache on server/ or serving/ code is
+    a generation-blind cache that survives a swap."""
+    src = """
+import functools
+
+@functools.lru_cache(maxsize=256)
+def serve_one(q):
+    return {"itemScores": []}
+
+@functools.cache
+def serve_two(q):
+    return {}
+"""
+    violations = lint_cache.check_source(
+        src, "predictionio_tpu/server/helper.py")
+    assert len(violations) == 2
+    assert all("generation" in v for v in violations)
+    # the cache module itself may use whatever it likes
+    assert lint_cache.check_source(
+        src, "predictionio_tpu/serving/result_cache.py") == []
+    # and code OFF the serve path is out of scope
+    assert lint_cache.check_source(
+        src, "predictionio_tpu/workflow/helper.py") == []
+
+
+def test_bare_lru_cache_import_is_flagged():
+    src = """
+from functools import lru_cache
+
+@lru_cache()
+def serve(q):
+    return {}
+"""
+    violations = lint_cache.check_source(
+        src, "predictionio_tpu/serving/helper.py")
+    assert len(violations) == 1
+
+
+def test_detects_result_cache_metric_outside_owner_module():
+    """Rule 3: single-owner pio_result_cache_* family."""
+    src = """
+def register(reg):
+    reg.counter("pio_result_cache_hits_total", "rogue", ("tier",))
+"""
+    violations = lint_cache.check_source(
+        src, "predictionio_tpu/server/engine_server.py")
+    assert any("rule 3" in v for v in violations)
+    assert lint_cache.check_source(
+        src, "predictionio_tpu/serving/result_cache.py") == []
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    assert lint_cache.main([str(REPO)]) == 0
+    server_dir = tmp_path / "predictionio_tpu" / "server"
+    server_dir.mkdir(parents=True)
+    (server_dir / "bad.py").write_text(
+        "import functools\n\n@functools.lru_cache\ndef f(q):\n"
+        "    return {}\n")
+    assert lint_cache.main([str(tmp_path)]) == 1

@@ -1,0 +1,609 @@
+"""Serve-side result cache (ISSUE 20): generation-keyed fast path.
+
+Acceptance spine: semantically-identical queries share ONE cache entry
+(canonical serialization); the LRU honors entry AND byte bounds;
+negative entries expire on their own short TTL (injectable clock, zero
+wall sleeps); promotion/rollback invalidate/revalidate by construction
+because the generation fingerprint IS the key — including a mid-flight
+swap, where a fill under the batcher-stamped OLD generation lands under
+the OLD fingerprint, never the new one; the shared fleet tier lets
+instance B hit an entry instance A filled, degrades to LRU-only on KV
+blips, and NEVER shares negatives; the live server serves zero
+stale-generation responses and zero non-2xx across a promotion under
+concurrent load; and a ~95%-hit-rate drive still feeds the quality
+layer's PSI windows at the configured sample rate.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from urllib.request import Request, urlopen
+
+import pytest
+
+from predictionio_tpu.controller import EngineVariant, RuntimeContext
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import App, get_storage
+from predictionio_tpu.data.storage.memory import MemoryKV
+from predictionio_tpu.obs import get_registry
+from predictionio_tpu.serving.result_cache import (
+    RESULT_CACHE_METRICS,
+    ResultCache,
+    ResultCacheConfig,
+    canonical_query,
+    query_defaults,
+)
+from predictionio_tpu.workflow.core_workflow import run_train
+
+TT_VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.twotower:engine",
+    "datasource": {"params": {"appName": "app"}},
+    "algorithms": [{"name": "twotower",
+                    "params": {"embedDim": 8, "hiddenDims": [16],
+                               "outDim": 8, "epochs": 2, "batchSize": 32,
+                               "seed": 1}}],
+}
+
+POS = {"itemScores": [{"item": "i1", "score": 1.5}]}
+NEG = {"itemScores": []}
+
+
+@pytest.fixture(autouse=True)
+def _iso(pio_home):
+    """Every test gets a fresh process-wide registry + storage — the
+    counter assertions below are exact, not delta-based."""
+    yield
+
+
+@dataclasses.dataclass
+class Q:
+    user: str
+    num: int = 10
+    exclude: list = dataclasses.field(default_factory=list)
+
+
+def _cache(clock=None, **cfg):
+    kw = {"clock": clock} if clock is not None else {}
+    c = ResultCache(ResultCacheConfig(**cfg), **kw)
+    c.on_generation(1, "fpA")
+    return c
+
+
+# ==========================================================================
+# Canonical serialization: ONE key per semantic query
+# ==========================================================================
+
+class TestCanonicalQuery:
+    def test_key_order_never_matters(self):
+        assert canonical_query({"num": 3, "user": "u1"}) \
+            == canonical_query({"user": "u1", "num": 3})
+
+    def test_explicit_default_strips_to_the_same_entry(self):
+        """``{"user": "u1"}`` and ``{"user": "u1", "num": 10}`` are the
+        same question when 10 is the dataclass default."""
+        assert canonical_query(Q("u1")) == canonical_query(Q("u1", 10))
+        d = query_defaults(Q)
+        assert canonical_query({"user": "u1"}, d) \
+            == canonical_query({"user": "u1", "num": 10}, d)
+        # a NON-default value keys distinctly
+        assert canonical_query(Q("u1", 5)) != canonical_query(Q("u1"))
+
+    def test_integral_floats_normalize(self):
+        """JSON clients that send ``num: 10.0`` mean ``num: 10``."""
+        d = query_defaults(Q)
+        assert canonical_query({"user": "u1", "num": 10.0}, d) \
+            == canonical_query({"user": "u1"}, d)
+        assert canonical_query({"user": "u1", "num": 3.0}, d) \
+            == canonical_query({"user": "u1", "num": 3}, d)
+
+    def test_default_factory_container_strips(self):
+        assert canonical_query(Q("u1", exclude=[])) \
+            == canonical_query(Q("u1"))
+
+    def test_exclude_carrying_queries_key_distinctly(self):
+        """Per-request exclude sets are part of the question — same
+        exclude shares an entry, different exclude does not."""
+        a = canonical_query(Q("u1", exclude=["i1"]))
+        b = canonical_query(Q("u1", exclude=["i1"]))
+        c = canonical_query(Q("u1", exclude=["i2"]))
+        assert a == b
+        assert a != c
+        assert a != canonical_query(Q("u1"))
+
+    def test_uncacheable_shapes_raise(self):
+        with pytest.raises(TypeError):
+            canonical_query("not a query")
+        with pytest.raises(TypeError):
+            json.loads(canonical_query({"user": object()}))
+
+
+# ==========================================================================
+# LRU bounds: entries AND bytes
+# ==========================================================================
+
+class TestBounds:
+    def test_entry_bound_evicts_lru(self):
+        c = _cache(max_entries=3)
+        for u in range(4):
+            c.fill(canonical_query({"user": f"u{u}"}), POS, 1)
+        assert c.lookup(canonical_query({"user": "u0"})) is None
+        assert c.lookup(canonical_query({"user": "u3"})) is not None
+        assert c.snapshot()["entries"] == 3
+        reg = get_registry()
+        assert reg.get("pio_result_cache_evictions_total").total() >= 1
+
+    def test_lookup_refreshes_recency(self):
+        c = _cache(max_entries=3)
+        for u in range(3):
+            c.fill(canonical_query({"user": f"u{u}"}), POS, 1)
+        assert c.lookup(canonical_query({"user": "u0"})) is not None
+        c.fill(canonical_query({"user": "u3"}), POS, 1)
+        # u1 (least recent) was evicted; the touched u0 survived
+        assert c.lookup(canonical_query({"user": "u0"})) is not None
+        assert c.lookup(canonical_query({"user": "u1"})) is None
+
+    def test_byte_bound_evicts(self):
+        big = {"itemScores": [{"item": "i" * 64, "score": 1.0}
+                              for _ in range(16)]}
+        one = len(json.dumps(big, separators=(",", ":")))
+        c = _cache(max_entries=1000, max_bytes=3 * one)
+        for u in range(4):
+            c.fill(canonical_query({"user": f"u{u}"}), big, 1)
+        snap = c.snapshot()
+        assert snap["bytes"] <= 3 * one
+        assert snap["entries"] < 4
+        assert c.lookup(canonical_query({"user": "u0"})) is None
+
+    def test_oversized_entry_never_sticks(self):
+        c = _cache(max_bytes=8)
+        c.fill(canonical_query({"user": "u0"}), POS, 1)
+        assert c.snapshot()["entries"] == 0
+        assert c.lookup(canonical_query({"user": "u0"})) is None
+
+
+# ==========================================================================
+# Negative caching: short independent TTL, injectable clock, NO sleeps
+# ==========================================================================
+
+class TestNegativeTTL:
+    def test_negative_expires_positive_does_not(self):
+        t = [0.0]
+        c = _cache(clock=lambda: t[0], neg_ttl_s=5.0)
+        pos_k = canonical_query({"user": "known"})
+        neg_k = canonical_query({"user": "unknown"})
+        assert c.fill(pos_k, POS, 1) == "positive"
+        assert c.fill(neg_k, NEG, 1) == "negative"
+        t[0] = 4.9
+        hit = c.lookup(neg_k)
+        assert hit is not None and hit.negative
+        t[0] = 5.1
+        assert c.lookup(neg_k) is None          # expired + retired
+        assert c.lookup(pos_k) is not None      # positives have no TTL
+        assert c.snapshot()["entries"] == 1
+
+    def test_expired_negative_refill_restarts_ttl(self):
+        t = [0.0]
+        c = _cache(clock=lambda: t[0], neg_ttl_s=5.0)
+        k = canonical_query({"user": "u"})
+        c.fill(k, NEG, 1)
+        t[0] = 6.0
+        assert c.lookup(k) is None
+        c.fill(k, NEG, 1)
+        t[0] = 10.0
+        assert c.lookup(k) is not None
+
+
+# ==========================================================================
+# Generation keying: swap invalidates, rollback revalidates, mid-flight
+# fills land under the STAMPED generation
+# ==========================================================================
+
+class TestGenerationKeying:
+    def test_swap_misses_rollback_revalidates(self):
+        c = _cache()
+        k = canonical_query({"user": "u1"})
+        c.fill(k, POS, 1)
+        assert c.lookup(k) is not None
+        c.on_generation(2, "fpB")           # promotion: new fingerprint
+        assert c.lookup(k) is None
+        c.on_generation(3, "fpA")           # rollback: old id restored
+        hit = c.lookup(k)
+        assert hit is not None and hit.generation == 1
+
+    def test_midflight_fill_lands_under_stamped_generation(self):
+        """A dispatch stamped generation 1 that hands back AFTER the swap
+        to generation 2 must fill under generation 1's fingerprint —
+        never the current one."""
+        c = _cache()
+        c.on_generation(2, "fpB")
+        k = canonical_query({"user": "u1"})
+        assert c.fill(k, POS, 1) == "positive"   # stamped gen, pre-swap
+        assert c.lookup(k) is None               # current fp is fpB
+        c.on_generation(3, "fpA")
+        assert c.lookup(k) is not None           # it sat under fpA
+
+    def test_unknown_generation_drops_the_fill(self):
+        c = _cache()
+        k = canonical_query({"user": "u1"})
+        assert c.fill(k, POS, 99) == "dropped"
+        assert c.fill(k, POS, None) == "dropped"
+        assert c.lookup(k) is None
+        reg = get_registry()
+        assert reg.get(
+            "pio_result_cache_fills_total").value(kind="dropped") == 2
+
+    def test_gen_map_is_bounded(self):
+        c = _cache()
+        for g in range(2, 20):
+            c.on_generation(g, f"fp{g}")
+        k = canonical_query({"user": "u1"})
+        assert c.fill(k, POS, 1) == "dropped"    # aged out of the map
+        assert c.fill(k, POS, 19) == "positive"
+
+    def test_unserializable_result_drops(self):
+        c = _cache()
+        assert c.fill(canonical_query({"user": "u"}),
+                      {"x": object()}, 1) == "dropped"
+
+    def test_disabled_cache_registers_zero_instruments(self):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = ResultCache(ResultCacheConfig(enabled=False), registry=reg)
+        c.on_generation(1, "fp")
+        assert c.lookup("{}") is None
+        assert c.fill("{}", POS, 1) == "disabled"
+        for name in RESULT_CACHE_METRICS:
+            assert reg.get(name) is None, name
+        # late enablement (bench A/B) registers on first use
+        c.set_enabled(True)
+        assert reg.get("pio_result_cache_hits_total") is not None
+
+
+# ==========================================================================
+# Shared fleet tier: B hits A's fill; blips degrade; negatives stay local
+# ==========================================================================
+
+def _shared_pair(kv, clock=None):
+    kw = {"clock": clock} if clock is not None else {}
+    cfg = ResultCacheConfig(shared=True)
+    a = ResultCache(cfg, kv=kv, **kw)
+    b = ResultCache(cfg, kv=kv, **kw)
+    a.on_generation(1, "fpX")
+    b.on_generation(7, "fpX")   # same instance id, different local gen
+    return a, b
+
+
+class _BlippyKV:
+    """KV that fails on demand and counts traffic."""
+
+    def __init__(self):
+        self.kv = MemoryKV()
+        self.fail = False
+        self.gets = 0
+
+    def get(self, ns, key):
+        self.gets += 1
+        if self.fail:
+            raise ConnectionError("kv down")
+        return self.kv.get(ns, key)
+
+    def put(self, ns, key, value):
+        if self.fail:
+            raise ConnectionError("kv down")
+        return self.kv.put(ns, key, value)
+
+    def prune(self, ns, keep):
+        return self.kv.prune(ns, keep)
+
+
+class TestSharedTier:
+    def test_instance_b_hits_what_a_filled(self):
+        kv = MemoryKV()
+        a, b = _shared_pair(kv)
+        k = canonical_query({"user": "u1"})
+        assert a.fill(k, POS, 1) == "positive"
+        hit = b.lookup(k)
+        assert hit is not None and hit.tier == "shared"
+        assert hit.result == POS
+        # adopted into B's local LRU: the next hit skips the KV
+        assert b.lookup(k).tier == "local"
+
+    def test_negatives_are_never_shared(self):
+        kv = MemoryKV()
+        a, b = _shared_pair(kv)
+        k = canonical_query({"user": "ghost"})
+        assert a.fill(k, NEG, 1) == "negative"
+        assert a.lookup(k) is not None           # local negative hit
+        assert b.lookup(k) is None               # not fleet truth
+
+    def test_fingerprint_scopes_the_namespace(self):
+        kv = MemoryKV()
+        a, b = _shared_pair(kv)
+        b.on_generation(8, "fpOTHER")
+        k = canonical_query({"user": "u1"})
+        a.fill(k, POS, 1)
+        assert b.lookup(k) is None
+
+    def test_blip_degrades_with_cooldown_then_recovers(self):
+        t = [0.0]
+        kv = _BlippyKV()
+        a, b = _shared_pair(kv, clock=lambda: t[0])
+        k = canonical_query({"user": "u1"})
+        a.fill(k, POS, 1)
+        kv.fail = True
+        assert b.lookup(k) is None               # degraded, not raised
+        n = kv.gets
+        assert b.lookup(k) is None               # cooldown: no KV call
+        assert kv.gets == n
+        reg = get_registry()
+        assert reg.get(
+            "pio_result_cache_shared_errors_total").total() >= 1
+        kv.fail = False
+        t[0] = 31.0                              # past the cooldown
+        assert b.lookup(k) is not None
+        assert kv.gets > n
+
+    def test_foreign_bytes_in_namespace_read_as_miss(self):
+        kv = MemoryKV()
+        a, b = _shared_pair(kv)
+        k = canonical_query({"user": "u1"})
+        kv.put(a._ns("fpX"), a._shared_key(k), b"not json at all")
+        assert b.lookup(k) is None
+
+
+# ==========================================================================
+# Live server: the seam end-to-end
+# ==========================================================================
+
+@pytest.fixture()
+def ctx(pio_home):
+    return RuntimeContext.create(storage=get_storage())
+
+
+def _mk_app(ctx, name="app"):
+    app_id = ctx.storage.get_apps().insert(App(id=None, name=name))
+    ctx.storage.get_events().init(app_id)
+    return app_id
+
+
+def _seed_views(ctx, app_id, n_users=10, n_items=6):
+    evs = [Event(event="view", entity_type="user", entity_id=f"u{u}",
+                 target_entity_type="item", target_entity_id=f"i{i}")
+           for u in range(n_users) for i in range(n_items)
+           if i % 2 == u % 2]
+    ctx.storage.get_events().insert_batch(evs, app_id)
+
+
+def _tt():
+    from predictionio_tpu.templates.twotower import engine
+
+    return engine(), EngineVariant.from_dict(TT_VARIANT)
+
+
+def _trained_server(ctx):
+    from predictionio_tpu.server import EngineServer
+
+    app_id = _mk_app(ctx)
+    _seed_views(ctx, app_id)
+    eng, variant = _tt()
+    run_train(eng, variant, ctx)
+    return EngineServer(eng, variant, ctx.storage, host="127.0.0.1",
+                        port=0), eng, variant
+
+
+def _q(srv, user="u1", num=3, **extra):
+    """(status, result-dict) for one handler-level query.  Hits come back
+    as pre-serialized bytes (the raw transport path); normalize so tests
+    compare documents either way."""
+    body = json.dumps({"user": user, "num": num, **extra}).encode()
+    out = srv.handle("POST", "/queries.json", body)
+    status, payload = out[0], out[1]
+    if isinstance(payload, (bytes, bytearray)):
+        payload = json.loads(payload.decode("utf-8"))
+    return status, payload
+
+
+class TestServerSeam:
+    def test_repeat_query_hits_and_snapshot_reports(self, ctx):
+        srv, _, _ = _trained_server(ctx)
+        try:
+            st1, r1 = _q(srv)
+            st2, r2 = _q(srv)
+            assert st1 == st2 == 200
+            assert r1 == r2
+            reg = get_registry()
+            assert reg.get("pio_result_cache_hits_total").total() >= 1
+            st, root = srv.handle("GET", "/", b"")
+            snap = root["resultCache"]
+            assert snap["hits"] >= 1 and snap["fingerprint"]
+            st, stats = srv.handle("GET", "/stats.json", b"")
+            assert stats["resultCache"]["hits"] >= 1
+            # the waterfall family carries the cache stage
+            from predictionio_tpu.obs.waterfall import (
+                ATTESTED_STAGES,
+                SERVE_STAGES,
+                WALL_STAGES,
+            )
+
+            for stages in (SERVE_STAGES, WALL_STAGES, ATTESTED_STAGES):
+                assert "cache" in stages
+        finally:
+            srv.stop()
+
+    def test_semantically_equal_http_queries_share_one_entry(self, ctx):
+        """An omitted ``num`` and an explicit ``num=10`` (the dataclass
+        default) are the same question on the wire."""
+        srv, _, _ = _trained_server(ctx)
+        try:
+            st, _ = srv.handle("POST", "/queries.json",
+                               json.dumps({"user": "u1"}).encode())
+            assert st == 200
+            reg = get_registry()
+            before = reg.get("pio_result_cache_hits_total").total()
+            st, _ = _q(srv, user="u1", num=10)   # default, explicit
+            assert st == 200
+            assert reg.get(
+                "pio_result_cache_hits_total").total() == before + 1
+        finally:
+            srv.stop()
+
+    def test_reload_invalidates_rollback_revalidates(self, ctx):
+        srv, eng, variant = _trained_server(ctx)
+        try:
+            _q(srv)                              # fill under gen 1
+            run_train(eng, variant, ctx)         # a second instance
+            st, body = srv.handle("POST", "/reload", b"")
+            assert st == 200
+            reg = get_registry()
+            misses0 = reg.get("pio_result_cache_misses_total").total()
+            _q(srv)                              # new fp: MUST miss
+            assert reg.get(
+                "pio_result_cache_misses_total").total() == misses0 + 1
+            st, _ = srv.handle("POST", "/admin/rollback", b"")
+            assert st == 200
+            hits0 = reg.get("pio_result_cache_hits_total").total()
+            _q(srv)                              # old fp restored: hit
+            assert reg.get(
+                "pio_result_cache_hits_total").total() == hits0 + 1
+        finally:
+            srv.stop()
+
+    def test_kill_switch_bypasses_and_registers_nothing(
+            self, ctx, monkeypatch):
+        monkeypatch.setenv("PIO_RESULT_CACHE", "off")
+        srv, _, _ = _trained_server(ctx)
+        try:
+            st1, _ = _q(srv)
+            st2, _ = _q(srv)
+            assert st1 == st2 == 200
+            reg = get_registry()
+            for name in RESULT_CACHE_METRICS:
+                assert reg.get(name) is None, name
+            st, root = srv.handle("GET", "/", b"")
+            assert root["resultCache"]["enabled"] is False
+        finally:
+            srv.stop()
+
+
+# ==========================================================================
+# Promotion atomicity under concurrent live-HTTP load (PR-4 harness)
+# ==========================================================================
+
+def _http_query(base, user, num=3):
+    req = Request(base + "/queries.json",
+                  data=json.dumps({"user": user, "num": num}).encode(),
+                  method="POST",
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=15) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _serve_gen(headers):
+    sid = headers.get("X-PIO-Serve-Id") or ""
+    if sid.startswith("g") and "-" in sid:
+        return int(sid[1:sid.index("-")])
+    return None
+
+
+class TestPromotionAtomicity:
+    def test_no_stale_generation_served_across_swap(
+            self, ctx, monkeypatch):
+        """Drive Zipf-ish repeats while a promotion swaps generations:
+        zero non-2xx, and every request SENT after the reload returned
+        carries the post-swap generation — a pre-swap cache entry can
+        never leak through, because the fingerprint key changed."""
+        monkeypatch.setenv("PIO_QUALITY_SAMPLE", "1.0")
+        srv, eng, variant = _trained_server(ctx)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        stop = threading.Event()
+        errors = []
+        statuses = []
+
+        def drive(i):
+            k = 0
+            while not stop.is_set():
+                try:
+                    st, headers, _ = _http_query(base, f"u{k % 4}")
+                    statuses.append(st)
+                except Exception as e:     # noqa: BLE001
+                    errors.append(repr(e))
+                k += 1
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            # warm the cache on generation 1
+            deadline = time.monotonic() + 10.0
+            reg = get_registry()
+            while time.monotonic() < deadline:
+                fam = reg.get("pio_result_cache_hits_total")
+                if fam is not None and fam.total() >= 8:
+                    break
+                time.sleep(0.01)
+            run_train(eng, variant, ctx)
+            st, _, _ = _reload(base)
+            assert st == 200
+            # every request sent AFTER the reload returned must serve
+            # the post-swap generation
+            post_gens = set()
+            for k in range(12):
+                st, headers, _ = _http_query(base, f"u{k % 4}")
+                assert st == 200
+                g = _serve_gen(headers)
+                assert g is not None
+                post_gens.add(g)
+            assert post_gens == {2}, post_gens
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            srv.stop()
+        assert not errors, errors
+        assert statuses and all(s == 200 for s in statuses)
+        # and the cache DID participate (this was a hot drive)
+        assert get_registry().get(
+            "pio_result_cache_hits_total").total() >= 8
+
+
+def _reload(base):
+    req = Request(base + "/reload", data=b"", method="POST")
+    with urlopen(req, timeout=60) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+# ==========================================================================
+# Quality layer keeps seeing scores at a 95% hit rate
+# ==========================================================================
+
+class TestQualityFeedOnHits:
+    def test_hit_heavy_drive_feeds_psi_at_sample_rate(
+            self, ctx, monkeypatch):
+        """A ~95%-hit-rate drive must still append to the prediction
+        record stream at the configured sample rate — hits carry the
+        filled response's serve-id semantics instead of starving the
+        drift windows."""
+        monkeypatch.setenv("PIO_QUALITY_SAMPLE", "1.0")
+        srv, _, _ = _trained_server(ctx)
+        try:
+            reg = get_registry()
+            n = 60
+            for k in range(n):
+                st, _ = _q(srv, user=f"u{k % 3}")   # 3 keys, 57 hits
+                assert st == 200
+            sampled = reg.get("pio_quality_sampled_total")
+            assert sampled is not None
+            assert sampled.total() >= n * 0.95
+            hits = reg.get("pio_result_cache_hits_total").total()
+            assert hits >= n - 3 - 5   # genuinely hit-heavy drive
+            # hit-path serves carry generation-attributed serve ids
+            st, doc = srv.handle("GET", "/quality.json", b"")
+            assert st == 200
+            assert doc["sampling"]["sampledTotal"] >= n * 0.95
+        finally:
+            srv.stop()

@@ -500,11 +500,16 @@ def _post(url, body):
 
 
 class TestEngineServerIntegration:
-    def test_queries_coalesce_over_http(self, trained):
+    def test_queries_coalesce_over_http(self, trained, monkeypatch):
         """Concurrent POST /queries.json share dispatches: requests >
-        dispatches once clients overlap (the tentpole, end to end)."""
+        dispatches once clients overlap (the tentpole, end to end).
+
+        The result cache is disabled: this test pins the BATCHER path
+        (repeated users would otherwise hit the cache and never reach
+        the scheduler's admission)."""
         from predictionio_tpu.server import EngineServer
 
+        monkeypatch.setenv("PIO_RESULT_CACHE", "0")
         eng, variant, storage, _ = trained
         srv = EngineServer(eng, variant, storage, host="127.0.0.1", port=0,
                            scheduler_config=SchedulerConfig(

@@ -793,6 +793,10 @@ class TestWaterfallEndToEnd:
 
         log = tmp_path / "requests.jsonl"
         monkeypatch.setenv("PIO_REQUEST_LOG", str(log))
+        # This pin is about the DISPATCH-path decomposition (queue_wait/
+        # batch_wait/dispatch on every row): repeated users would hit the
+        # result cache and legitimately skip those stages, so bypass it.
+        monkeypatch.setenv("PIO_RESULT_CACHE", "0")
         eng, variant, storage, _ = trained
         srv = EngineServer(eng, variant, storage, host="127.0.0.1",
                            port=0)

@@ -34,14 +34,14 @@ import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "dispatch",
-          "resume", "retrieval", "serialize", "shed_check")
+STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
+          "dispatch", "resume", "retrieval", "serialize", "shed_check")
 # Additive stages: their sum ≈ the request's total server wall.
-WALL_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "dispatch",
-               "resume", "serialize", "shed_check")
+WALL_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
+               "dispatch", "resume", "serialize", "shed_check")
 # The subset the X-PIO-Server-Ms attestation CONTAINS (the header is
 # read before the response is written, so serialize lies outside it).
-ATTESTED_STAGES = ("ingress", "queue_wait", "batch_wait", "bind",
+ATTESTED_STAGES = ("ingress", "queue_wait", "batch_wait", "bind", "cache",
                    "dispatch", "resume", "shed_check")
 
 ATTACKS = {
@@ -64,6 +64,11 @@ ATTACKS = {
                   "the window",
     "bind": "query binding — simplify the query_class schema or trim "
             "payload size (bind runs per-request on the handler thread)",
+    "cache": "result-cache canonicalization + lookup — sub-millisecond "
+             "by design; if it dominates, the traffic is hitting (good: "
+             "queue/dispatch are gone from those requests) or queries "
+             "are huge (canonicalization is O(payload)); check "
+             "pio_result_cache_hit_rate before reading further rows",
     "dispatch": "model execution — grow PIO_BATCH_MAX to amortize more "
                 "requests per dispatch (check HBM headroom first), or "
                 "attack the model itself; if retrieval dominates the "

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Static check: ONE result-cache seam on the serve path (ISSUE 20).
+
+The result cache is only sound because every ``/queries.json`` answer
+flows through exactly one lookup/fill seam keyed by (generation
+fingerprint, canonical query).  A handler that memoizes results on the
+side — a dict keyed by raw query text, an ``lru_cache`` on a serve
+helper — reintroduces the invalidation problem the fingerprint key
+design deleted: promotion/rollback would no longer miss by construction.
+This lint locks the seam in (tier-1 test runs it in CI):
+
+1. In ``server/engine_server.py``, every function that calls
+   ``scheduler.submit_and_wait(...)`` must consult the cache facade
+   around it: a ``result_cache.lookup(...)`` BEFORE the submit and a
+   ``result_cache.fill(...)`` AFTER it (source order).  Engine query
+   results reach the transport only through that seam.
+2. No ad-hoc memoization primitives (``functools.lru_cache`` /
+   ``functools.cache``) anywhere in ``predictionio_tpu/server/`` or
+   ``predictionio_tpu/serving/`` outside the cache module itself —
+   those decorators have no generation key and survive a swap.
+3. ``pio_result_cache_*`` metric families REGISTER only in
+   ``serving/result_cache.py`` — the ``pio status`` line, the
+   ``/stats.json`` snapshot, and the fleet merge derive their schema
+   from that one module (same single-owner contract the quality and
+   recall families live under in ``tools/lint_metrics.py``).
+
+Usage: ``python tools/lint_cache.py [root]`` — prints violations and
+exits non-zero when any exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+_CACHE_MODULE = "serving/result_cache.py"
+_MEMO_NAMES = {"lru_cache", "cache"}
+
+
+def _norm(filename: str) -> str:
+    return filename.replace("\\", "/")
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['self', 'result_cache', 'lookup'] for self.result_cache.lookup."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _is_memo_decorator(dec: ast.AST) -> bool:
+    """functools.lru_cache / functools.cache, bare or called."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    chain = _attr_chain(dec)
+    if not chain:
+        return False
+    if chain[-1] in _MEMO_NAMES:
+        # bare `cache` as a name is too common to flag; require the
+        # functools spelling for it, but flag `lru_cache` either way.
+        if chain[-1] == "cache":
+            return len(chain) > 1 and chain[-2] == "functools"
+        return True
+    return False
+
+
+def _check_submit_seam(tree: ast.Module, filename: str) -> List[str]:
+    """Rule 1: lookup-before / fill-after around every submit_and_wait."""
+    violations: List[str] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        submits: List[int] = []
+        lookups: List[int] = []
+        fills: List[int] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] == "submit_and_wait":
+                submits.append(node.lineno)
+            elif chain[-1] == "lookup" and "result_cache" in chain:
+                lookups.append(node.lineno)
+            elif chain[-1] == "fill" and "result_cache" in chain:
+                fills.append(node.lineno)
+        for line in submits:
+            if not any(ln < line for ln in lookups):
+                violations.append(
+                    f"{filename}:{line}: submit_and_wait() without a "
+                    f"result_cache.lookup() before it — engine results "
+                    f"must reach the transport through the cache seam "
+                    f"(rule 1)")
+            if not any(ln > line for ln in fills):
+                violations.append(
+                    f"{filename}:{line}: submit_and_wait() without a "
+                    f"result_cache.fill() after it — a dispatched answer "
+                    f"that skips the fill seam starves the cache and "
+                    f"invites ad-hoc memoization (rule 1)")
+    return violations
+
+
+def check_source(source: str, filename: str,
+                 registry: Optional[Dict[str, str]] = None) -> List[str]:
+    """Violations in one module; ``registry`` is unused state kept for
+    signature parity with the sibling lints (callers pass {})."""
+    registry = registry if registry is not None else {}
+    violations: List[str] = []
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [f"{filename}:{e.lineno}: unparseable: {e.msg}"]
+    fname = _norm(filename)
+    in_cache_module = fname.endswith(_CACHE_MODULE)
+    on_serve_path = ("predictionio_tpu/server/" in fname
+                     or "predictionio_tpu/serving/" in fname)
+
+    # rule 1: the seam itself, in the engine server only
+    if fname.endswith("server/engine_server.py"):
+        violations.extend(_check_submit_seam(tree, filename))
+
+    for node in ast.walk(tree):
+        # rule 2: no generation-blind memoization on the serve path
+        if (on_serve_path and not in_cache_module
+                and isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))):
+            for dec in node.decorator_list:
+                if _is_memo_decorator(dec):
+                    violations.append(
+                        f"{filename}:{node.lineno}: function "
+                        f"{node.name!r} memoized with functools on the "
+                        f"serve path — such caches have no generation "
+                        f"key and survive a model swap; go through the "
+                        f"result-cache facade (rule 2)")
+        # rule 3: single-owner pio_result_cache_* family
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")):
+            args = node.args
+            name_node = args[0] if args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_node = kw.value
+            if (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)
+                    and name_node.value.startswith("pio_result_cache")
+                    and not in_cache_module):
+                violations.append(
+                    f"{filename}:{node.lineno}: result-cache metric "
+                    f"{name_node.value!r} registered outside "
+                    f"{_CACHE_MODULE} — the family schema is owned by "
+                    f"that one module (rule 3)")
+    return violations
+
+
+def check(root: Path | str | None = None) -> List[str]:
+    root = Path(root) if root else Path(__file__).resolve().parents[1]
+    pkg = root / "predictionio_tpu"
+    violations: List[str] = []
+    for path in sorted(pkg.rglob("*.py")):
+        violations.extend(check_source(
+            path.read_text(encoding="utf-8"), str(path), {}))
+    return violations
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    violations = check(argv[0] if argv else None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} cache-lint violation(s).",
+              file=sys.stderr)
+        return 1
+    print("lint_cache: engine results flow through the one lookup/fill "
+          "seam; no serve-path memoization; result-cache metrics are "
+          "single-owner.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
